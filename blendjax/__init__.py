@@ -1,0 +1,49 @@
+"""blendjax — TPU-native real-time Blender -> JAX streaming framework.
+
+A ground-up, TPU-first re-design of the capabilities of blendtorch
+(reference: ``/root/reference``, see SURVEY.md): N Blender processes render
+randomized scenes and stream images + annotations over ZMQ into a JAX/XLA
+training pipeline, with bi-directional control channels and gym-style remote
+environments.  The consumer side replaces torch DataLoaders with a threaded
+stream loader feeding a double-buffered ``jax.device_put`` prefetcher so
+frames land directly in TPU HBM; scale-out is per-host Blender fleets plus
+``jax.sharding`` meshes on the training side.
+
+Subpackages
+-----------
+- ``blendjax.btt``   consumer side (host / JAX): launcher, streaming dataset,
+  record/replay, duplex channel, remote environments, device feed.
+- ``blendjax.btb``   producer side (runs inside Blender's Python): animation
+  controller, offscreen renderer, camera annotations, publisher, duplex,
+  remote-controlled environments.  Importable without bpy/jax installed.
+- ``blendjax.models``  TPU-first example models (detector, discriminator,
+  probability model, policies) in pure jax + optax.
+- ``blendjax.ops``     image ops (sRGB decode, normalize, augment) incl. a
+  Pallas TPU kernel for the hot uint8->bf16 path.
+- ``blendjax.parallel`` mesh/sharding helpers and the vectorized env pool.
+- ``blendjax.utils``    timing/tracing, logging.
+
+This module is import-light on purpose: importing :mod:`blendjax` pulls in
+neither jax, torch, nor bpy, so the same wheel serves Blender's embedded
+Python and the TPU host.
+"""
+
+__version__ = "0.1.0"
+
+from blendjax import wire  # noqa: F401  (pure stdlib + zmq/numpy, always safe)
+
+_SUBMODULES = ("btt", "btb", "models", "ops", "parallel", "utils", "wire")
+
+
+def __getattr__(name):  # PEP 562 lazy subpackage access
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"blendjax.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'blendjax' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

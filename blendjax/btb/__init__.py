@@ -1,0 +1,58 @@
+"""blendjax.btb — producer-side package, runs inside Blender's Python.
+
+Mirrors the reference's ``blendtorch.btb`` surface
+(``pkg_blender/blendtorch/btb/__init__.py:1-9``) so existing publisher
+scripts port by changing the import line.  Attribute access is lazy (PEP
+562): modules that need ``bpy``/``gpu`` only import when first touched, so
+the package is importable (and unit-testable) outside Blender.
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    # name -> (module, attr)
+    "parse_blendtorch_args": ("blendjax.btb.arguments", "parse_blendtorch_args"),
+    "parse_btargs": ("blendjax.btb.arguments", "parse_btargs"),
+    "BlendJaxArgs": ("blendjax.btb.arguments", "BlendJaxArgs"),
+    "Signal": ("blendjax.btb.signal", "Signal"),
+    "AnimationController": ("blendjax.btb.animation", "AnimationController"),
+    "OffScreenRenderer": ("blendjax.btb.offscreen", "OffScreenRenderer"),
+    "Camera": ("blendjax.btb.camera", "Camera"),
+    "DataPublisher": ("blendjax.btb.publisher", "DataPublisher"),
+    "DuplexChannel": ("blendjax.btb.duplex", "DuplexChannel"),
+    "BaseEnv": ("blendjax.btb.env", "BaseEnv"),
+    "RemoteControlledAgent": ("blendjax.btb.env", "RemoteControlledAgent"),
+}
+
+_LAZY_MODULES = (
+    "arguments",
+    "signal",
+    "animation",
+    "offscreen",
+    "camera",
+    "camera_math",
+    "publisher",
+    "duplex",
+    "env",
+    "utils",
+    "constants",
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY:
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value
+        return value
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module(f"blendjax.btb.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'blendjax.btb' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY) + list(_LAZY_MODULES)))

@@ -1,0 +1,5 @@
+"""Producer-side defaults (reference ``btb/constants.py:4``)."""
+
+#: Default socket timeout inside Blender.  Shorter than the consumer side:
+#: a stuck producer should fail fast rather than stall the animation loop.
+DEFAULT_TIMEOUTMS = 5000

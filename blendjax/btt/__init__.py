@@ -1,0 +1,69 @@
+"""blendjax.btt — consumer-side package (training host, JAX).
+
+Mirrors the reference's ``blendtorch.btt`` surface
+(``pkg_pytorch/blendtorch/btt/__init__.py:1-8``) but torch-free: the
+DataLoader role is played by :class:`blendjax.btt.loader.BatchLoader` plus
+the double-buffered device feed in :mod:`blendjax.btt.prefetch`.  Attribute
+access is lazy so importing the package never drags in jax (only the device
+feed and env-pool modules need it).
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "BlenderLauncher": ("blendjax.btt.launcher", "BlenderLauncher"),
+    "discover_blender": ("blendjax.btt.finder", "discover_blender"),
+    "LaunchInfo": ("blendjax.btt.launch_info", "LaunchInfo"),
+    "RemoteIterableDataset": ("blendjax.btt.dataset", "RemoteIterableDataset"),
+    "SingleFileDataset": ("blendjax.btt.dataset", "SingleFileDataset"),
+    "FileDataset": ("blendjax.btt.dataset", "FileDataset"),
+    "FileRecorder": ("blendjax.btt.file", "FileRecorder"),
+    "FileReader": ("blendjax.btt.file", "FileReader"),
+    "DuplexChannel": ("blendjax.btt.duplex", "DuplexChannel"),
+    "BatchLoader": ("blendjax.btt.loader", "BatchLoader"),
+    "collate": ("blendjax.btt.collate", "collate"),
+    "device_prefetch": ("blendjax.btt.prefetch", "device_prefetch"),
+    "JaxStream": ("blendjax.btt.prefetch", "JaxStream"),
+    "RemoteEnv": ("blendjax.btt.env", "RemoteEnv"),
+    "launch_env": ("blendjax.btt.env", "launch_env"),
+    "OpenAIRemoteEnv": ("blendjax.btt.env", "OpenAIRemoteEnv"),
+    "EnvPool": ("blendjax.btt.envpool", "EnvPool"),
+    "get_primary_ip": ("blendjax.btt.utils", "get_primary_ip"),
+}
+
+_LAZY_MODULES = (
+    "launcher",
+    "finder",
+    "launch_info",
+    "dataset",
+    "file",
+    "duplex",
+    "loader",
+    "collate",
+    "prefetch",
+    "env",
+    "envpool",
+    "env_rendering",
+    "utils",
+    "constants",
+    "apps",
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY:
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value
+        return value
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module(f"blendjax.btt.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'blendjax.btt' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY) + list(_LAZY_MODULES)))

@@ -1,0 +1,23 @@
+"""Consumer-side network utilities (reference ``btt/utils.py:2-17``)."""
+
+from __future__ import annotations
+
+import socket
+
+
+def get_primary_ip() -> str:
+    """IP of the default-route interface; falls back to localhost.
+
+    Uses the UDP-connect trick: no packet is sent, the OS just resolves the
+    route.  Used by the launcher's ``bind_addr='primaryip'`` mode so remote
+    consumers on other TPU-VM hosts can connect (reference
+    ``launcher.py:187-188``).
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

@@ -1,0 +1,154 @@
+"""Wire protocol shared by producer (Blender) and consumer (JAX host).
+
+The reference spreads its wire format across both packages (pickled dict via
+``send_pyobj``/``recv_pyobj`` with an auto-stamped producer id — reference
+``pkg_blender/blendtorch/btb/publisher.py:41-43``,
+``pkg_pytorch/blendtorch/btt/dataset.py:105``,
+``*/duplex.py:60-66``).  blendjax centralizes it here and keeps two
+interoperable encodings on every socket:
+
+1. **compat** — one frame holding ``pickle.dumps(dict)``.  Byte-compatible
+   with reference producers/consumers, so existing ``*.blend.py`` publisher
+   scripts stream into blendjax unmodified and vice versa.
+2. **raw-buffer** — multipart ``[header, buf0, buf1, ...]`` where the header
+   is a pickled dict with ndarray leaves replaced by placeholders and the
+   array payloads ride as separate zero-copy ZMQ frames.  Decoding is a
+   ``np.frombuffer`` view per array instead of a pickle memcpy — the biggest
+   serialization win for 640x480x4 frames (SURVEY.md §7 "hard parts").
+
+Receivers auto-detect the encoding per message (multipart => raw-buffer), so
+mixed fleets work.
+
+Pickle protocol is pinned to 4: the newest protocol that Blender 2.8x's
+bundled Python 3.7 can read (the reference pins protocol 3 for the same
+reason in ``pkg_pytorch/blendtorch/btt/file.py:59-63``; 4 is available from
+Python 3.4 and is faster for large buffers).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import zmq
+
+#: Newest pickle protocol readable by every Blender >= 2.80 (Python >= 3.7).
+PICKLE_PROTOCOL = 4
+
+#: Default high-water mark on both ends of the data plane.  Small on purpose:
+#: a slow trainer stalls producers (backpressure) instead of buffering
+#: unboundedly (reference ``publisher.py:24-27``, ``dataset.py:73-78``).
+DEFAULT_HWM = 10
+
+#: Key stamped into every data-plane message identifying the producer
+#: instance (reference ``publisher.py:42``).
+BTID_KEY = "btid"
+
+#: Key stamped into every duplex message: a random per-message id usable for
+#: request/response correlation (reference ``duplex.py:60-66``).
+BTMID_KEY = "btmid"
+
+_ARRAY_PLACEHOLDER = "__bjx_nd__"
+
+
+def new_message_id() -> str:
+    """Random 4-byte hex message id (reference ``duplex.py:63``)."""
+    return os.urandom(4).hex()
+
+
+def dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=PICKLE_PROTOCOL)
+
+
+def loads(buf) -> object:
+    return pickle.loads(buf)
+
+
+# ---------------------------------------------------------------------------
+# raw-buffer encoding
+# ---------------------------------------------------------------------------
+
+
+def _strip_arrays(obj, bufs: list):
+    """Replace ndarray leaves in a nested container with placeholders.
+
+    Supports the containers the data plane actually carries (dict/list/tuple
+    of numpy arrays and scalars).  Non-contiguous arrays are copied once.
+    """
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        bufs.append(arr)
+        return {
+            _ARRAY_PLACEHOLDER: len(bufs) - 1,
+            "dtype": arr.dtype.str,
+            "shape": arr.shape,
+        }
+    if isinstance(obj, dict):
+        return {k: _strip_arrays(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_strip_arrays(v, bufs) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def _restore_arrays(obj, frames):
+    if isinstance(obj, dict):
+        if _ARRAY_PLACEHOLDER in obj:
+            idx = obj[_ARRAY_PLACEHOLDER]
+            arr = np.frombuffer(frames[idx], dtype=np.dtype(obj["dtype"]))
+            return arr.reshape(obj["shape"])
+        return {k: _restore_arrays(v, frames) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_restore_arrays(v, frames) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def encode(data: dict, raw_buffers: bool = False) -> list:
+    """Encode a message dict into a list of ZMQ frames."""
+    if not raw_buffers:
+        return [dumps(data)]
+    bufs: list = []
+    header = _strip_arrays(data, bufs)
+    return [dumps(header)] + bufs
+
+
+def decode(frames) -> dict:
+    """Decode frames produced by :func:`encode` (either encoding)."""
+    head = pickle.loads(frames[0])
+    if len(frames) == 1:
+        return head
+    return _restore_arrays(head, [memoryview(f) for f in frames[1:]])
+
+
+# ---------------------------------------------------------------------------
+# socket send/recv
+# ---------------------------------------------------------------------------
+
+
+def send_message(socket: zmq.Socket, data: dict, raw_buffers: bool = False, flags: int = 0):
+    frames = encode(data, raw_buffers=raw_buffers)
+    if len(frames) == 1:
+        socket.send(frames[0], flags=flags)
+    else:
+        socket.send_multipart(frames, flags=flags, copy=False)
+
+
+def recv_message(socket: zmq.Socket, flags: int = 0) -> dict:
+    frames = socket.recv_multipart(flags=flags, copy=False)
+    return decode([f.buffer for f in frames])
+
+
+def recv_message_raw(socket: zmq.Socket, flags: int = 0):
+    """Receive without decoding; returns the raw frame list (bytes).
+
+    Used by the stream recorder, which persists the on-wire bytes verbatim
+    (reference ``dataset.py:100-105`` records pre-unpickle bytes).
+    """
+    return socket.recv_multipart(flags=flags, copy=True)
+
+
+def decode_raw_frames(frames) -> dict:
+    """Decode frames previously captured by :func:`recv_message_raw`."""
+    return decode(frames)

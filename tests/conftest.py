@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Forces JAX onto an 8-device virtual CPU mesh *before* jax is imported
+anywhere, so multi-chip sharding paths (dp/tp meshes, prefetch shardings)
+are exercised without TPU hardware.  Real-Blender and real-TPU tests hide
+behind the ``blender`` / ``tpu`` markers.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(__file__))  # tests/helpers importable

@@ -1,0 +1,38 @@
+"""Arg-protocol tests (reference behavior: ``tests/test_launcher.py:20-44``
+validates btid/btseed/btsockets/remainder wiring)."""
+
+import pytest
+
+from blendjax.btb.arguments import parse_blendtorch_args
+
+
+def test_parse_full():
+    argv = [
+        "blender", "--background", "--python", "s.py", "--",
+        "-btid", "2", "-btseed", "12", "-btsockets",
+        "DATA=tcp://127.0.0.1:11000", "CTRL=tcp://127.0.0.1:11001",
+        "--render-every", "3",
+    ]
+    args, remainder = parse_blendtorch_args(argv)
+    assert args.btid == 2
+    assert args.btseed == 12
+    assert args.btsockets == {
+        "DATA": "tcp://127.0.0.1:11000",
+        "CTRL": "tcp://127.0.0.1:11001",
+    }
+    assert remainder == ["--render-every", "3"]
+
+
+def test_parse_no_separator_uses_all():
+    args, rem = parse_blendtorch_args(["-btid", "5"])
+    assert args.btid == 5 and rem == []
+
+
+def test_parse_defaults():
+    args, rem = parse_blendtorch_args(["--"])
+    assert args.btid == 0 and args.btseed == 0 and args.btsockets == {}
+
+
+def test_bad_socket_entry():
+    with pytest.raises(ValueError):
+        parse_blendtorch_args(["--", "-btsockets", "DATAtcp://x"])

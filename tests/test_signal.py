@@ -1,0 +1,33 @@
+from blendjax.btb.signal import Signal
+
+
+def test_invoke_order_and_args():
+    calls = []
+    sig = Signal()
+    sig.add(lambda x: calls.append(("a", x)))
+    sig.add(lambda tag, x: calls.append((tag, x)), "bound")
+    sig.invoke(7)
+    assert calls == [("a", 7), ("bound", 7)]
+
+
+def test_remove_by_handle():
+    sig = Signal()
+    h = sig.add(lambda: None)
+    assert len(sig) == 1
+    sig.remove(h)
+    assert len(sig) == 0
+
+
+def test_handler_can_unregister_during_dispatch():
+    sig = Signal()
+    calls = []
+
+    def once():
+        calls.append(1)
+        sig.remove(h)
+
+    h = sig.add(once)
+    sig.add(lambda: calls.append(2))
+    sig.invoke()
+    sig.invoke()
+    assert calls == [1, 2, 2]

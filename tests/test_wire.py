@@ -1,0 +1,77 @@
+"""Wire-format tests: compat pickle encoding, raw-buffer zero-copy encoding,
+and cross-encoding interop over a real ZMQ socket pair."""
+
+import numpy as np
+import pytest
+import zmq
+
+from blendjax import wire
+
+
+def test_compat_roundtrip():
+    msg = {"image": np.zeros((4, 6, 3), np.uint8), "xy": [1.0, 2.0], "btid": 3}
+    frames = wire.encode(msg, raw_buffers=False)
+    assert len(frames) == 1
+    out = wire.decode(frames)
+    assert out["btid"] == 3
+    np.testing.assert_array_equal(out["image"], msg["image"])
+
+
+def test_raw_buffer_roundtrip_nested():
+    rng = np.random.default_rng(0)
+    msg = {
+        "image": rng.integers(0, 255, (8, 8, 4), dtype=np.uint8),
+        "nested": {"depth": rng.standard_normal((8, 8)).astype(np.float32)},
+        "seq": [np.arange(5), "label"],
+        "tup": (np.ones(3), 7),
+        "frameid": 42,
+    }
+    frames = wire.encode(msg, raw_buffers=True)
+    assert len(frames) == 1 + 4  # header + 4 arrays
+    out = wire.decode(frames)
+    np.testing.assert_array_equal(out["image"], msg["image"])
+    np.testing.assert_array_equal(out["nested"]["depth"], msg["nested"]["depth"])
+    np.testing.assert_array_equal(out["seq"][0], msg["seq"][0])
+    assert out["seq"][1] == "label"
+    assert isinstance(out["tup"], tuple) and out["tup"][1] == 7
+    assert out["frameid"] == 42
+
+
+def test_raw_buffer_noncontiguous():
+    arr = np.arange(24).reshape(4, 6)[::2, ::3]
+    out = wire.decode(wire.encode({"a": arr}, raw_buffers=True))
+    np.testing.assert_array_equal(out["a"], arr)
+
+
+@pytest.mark.parametrize("raw", [False, True])
+def test_socket_interop(raw):
+    ctx = zmq.Context()
+    try:
+        push = ctx.socket(zmq.PUSH)
+        port = push.bind_to_random_port("tcp://127.0.0.1")
+        pull = ctx.socket(zmq.PULL)
+        pull.connect(f"tcp://127.0.0.1:{port}")
+        msg = {"image": np.full((5, 5), 7, np.uint8), "btid": 1}
+        wire.send_message(push, msg, raw_buffers=raw)
+        assert pull.poll(5000)
+        out = wire.recv_message(pull)
+        np.testing.assert_array_equal(out["image"], msg["image"])
+        assert out["btid"] == 1
+    finally:
+        ctx.destroy(linger=0)
+
+
+def test_reference_compat_bytes():
+    # A reference producer does pickle.dumps(dict) in one frame; our decoder
+    # must accept it unchanged.
+    import pickle
+
+    msg = {"image": np.zeros((2, 2), np.uint8), "btid": 0}
+    out = wire.decode([pickle.dumps(msg)])
+    np.testing.assert_array_equal(out["image"], msg["image"])
+
+
+def test_message_id_unique():
+    ids = {wire.new_message_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(len(i) == 8 for i in ids)
