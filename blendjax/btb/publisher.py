@@ -1,0 +1,66 @@
+"""Data-plane publisher running inside Blender (reference ``btb/publisher.py:4-43``).
+
+A PUSH socket that **binds** (consumers connect to all producers, giving M:N
+fan-in with ZMQ fair queuing).  ``SNDHWM`` is small and ``IMMEDIATE=1`` so a
+producer stalls when the trainer lags instead of buffering frames
+unboundedly — the backpressure that keeps memory flat when Blender renders
+faster than the TPU consumes (reference ``publisher.py:21-27``,
+``examples/datagen/Readme.md:168-175``).
+
+Unlike the reference this module needs no ``bpy``: it is plain ZMQ and is
+exercised directly by the fake-Blender test fleet.  Set ``raw_buffers=True``
+to use blendjax's zero-copy multipart encoding for ndarray payloads (see
+:mod:`blendjax.wire`); leave it False for byte-compat with reference
+consumers.
+"""
+
+from __future__ import annotations
+
+import zmq
+
+from blendjax import wire
+
+
+class DataPublisher:
+    """Publishes message dicts to connected consumers.
+
+    Params
+    ------
+    bind_address: str
+        Address to bind, e.g. ``tcp://127.0.0.1:11000`` (from
+        ``-btsockets DATA=...``).
+    btid: int | None
+        Producer id stamped into every message.
+    send_hwm: int
+        High-water mark; send blocks once this many messages queue.
+    raw_buffers: bool
+        Use zero-copy multipart encoding for ndarrays.
+    """
+
+    def __init__(
+        self,
+        bind_address,
+        btid=None,
+        send_hwm=wire.DEFAULT_HWM,
+        raw_buffers=False,
+        lingerms=0,
+    ):
+        self.btid = btid
+        self.raw_buffers = raw_buffers
+        self._ctx = zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.PUSH)
+        self.sock.setsockopt(zmq.SNDHWM, send_hwm)
+        self.sock.setsockopt(zmq.IMMEDIATE, 1)
+        self.sock.setsockopt(zmq.LINGER, lingerms)
+        self.sock.bind(bind_address)
+
+    def publish(self, **kwargs):
+        """Send one message dict; blocks under backpressure.
+
+        ``btid`` is stamped automatically (reference ``publisher.py:41-43``).
+        """
+        data = {wire.BTID_KEY: self.btid, **kwargs}
+        wire.send_message(self.sock, data, raw_buffers=self.raw_buffers)
+
+    def close(self):
+        self.sock.close(0)

@@ -1,0 +1,58 @@
+"""Serializable launch metadata (reference ``btt/launch_info.py:4-63``).
+
+``LaunchInfo`` carries the socket addresses (grouped by name), the spawn
+command lines, and — when locally launched — the process handles.  The JSON
+round-trip is the multi-machine handoff: launch Blender fleets on host A via
+``blendjax-launch``, ship ``launch_info.json`` to host B, connect a
+``RemoteIterableDataset`` to ``info.addresses['DATA']``.
+
+Fixes the reference's latent ``nullcontext`` NameError on the file-like-
+object path (``launch_info.py:38`` uses it without importing it).
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import nullcontext
+
+
+class LaunchInfo:
+    """Addresses, commands and (optionally) process handles of a launch."""
+
+    def __init__(self, addresses, commands, processes=None):
+        self.addresses = dict(addresses)
+        self.commands = list(commands)
+        self.processes = processes
+
+    def __repr__(self):
+        return f"LaunchInfo(addresses={self.addresses!r})"
+
+    @staticmethod
+    def save_json(file, launch_info):
+        """Write addresses+commands as JSON to a path or file-like object."""
+        ctx = (
+            nullcontext(file)
+            if hasattr(file, "write")
+            else open(file, "w", encoding="utf-8")
+        )
+        with ctx as fp:
+            json.dump(
+                {
+                    "addresses": launch_info.addresses,
+                    "commands": launch_info.commands,
+                },
+                fp,
+                indent=2,
+            )
+
+    @staticmethod
+    def load_json(file) -> "LaunchInfo":
+        """Read a :class:`LaunchInfo` from a path or file-like object."""
+        ctx = (
+            nullcontext(file)
+            if hasattr(file, "read")
+            else open(file, "r", encoding="utf-8")
+        )
+        with ctx as fp:
+            data = json.load(fp)
+        return LaunchInfo(data["addresses"], data["commands"])

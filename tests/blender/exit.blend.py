@@ -1,0 +1,17 @@
+"""Producer fixture that publishes once and exits immediately (used by the
+launch-CLI and failure-detection tests)."""
+
+import time
+
+from blendjax.btb.arguments import parse_blendtorch_args
+from blendjax.btb.publisher import DataPublisher
+
+
+def main():
+    args, _ = parse_blendtorch_args()
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid, lingerms=2000)
+    pub.publish(btid=args.btid)
+    time.sleep(0.2)  # let the consumer drain before the socket dies
+
+
+main()

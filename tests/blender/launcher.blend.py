@@ -1,0 +1,24 @@
+"""Producer fixture: echoes its parsed launch args once over DATA, then
+idles until terminated (mirrors the reference fixture pattern,
+``tests/blender/launcher.blend.py:7-8``)."""
+
+import time
+
+from blendjax.btb.arguments import parse_blendtorch_args
+from blendjax.btb.publisher import DataPublisher
+
+
+def main():
+    args, remainder = parse_blendtorch_args()
+    pub = DataPublisher(args.btsockets["DATA"], btid=args.btid)
+    pub.publish(
+        btid=args.btid,
+        btseed=args.btseed,
+        btsockets=args.btsockets,
+        remainder=remainder,
+    )
+    # Idle so the launcher controls our lifetime (terminated on __exit__).
+    time.sleep(60)
+
+
+main()

@@ -1,0 +1,7 @@
+"""Test helpers: fake Blender executable + fleet utilities."""
+
+import os
+
+HELPER_DIR = os.path.dirname(os.path.abspath(__file__))
+FAKE_BLENDER = os.path.join(HELPER_DIR, "fake_blender.py")
+BLEND_SCRIPTS = os.path.join(os.path.dirname(HELPER_DIR), "blender")

@@ -1,0 +1,49 @@
+#!/usr/bin/env python3
+"""A stand-in for the Blender executable used by CI (no real Blender needed).
+
+Honors the CLI subset blendjax relies on (SURVEY.md §4 recommends exactly
+this: a fake producer speaking the real protocol so the consumer pipeline is
+testable without Blender):
+
+- ``--version``                      -> prints a Blender-style version line
+- ``[scene.blend] [--background] --python-use-system-env
+  [--python-exit-code N] --python script.py -- ...``
+                                     -> executes ``script.py`` with
+  ``sys.argv`` set to the full command line, exactly as Blender's embedded
+  interpreter does, so ``parse_blendtorch_args`` sees the real protocol.
+"""
+
+import runpy
+import sys
+
+
+def main():
+    argv = sys.argv
+    if "--version" in argv:
+        print("Blender 4.2.1 (fake, blendjax test fleet)")
+        return 0
+
+    script = None
+    exit_code_on_error = 1
+    if "--python" in argv:
+        script = argv[argv.index("--python") + 1]
+    if "--python-exit-code" in argv:
+        exit_code_on_error = int(argv[argv.index("--python-exit-code") + 1])
+
+    if script is None:
+        return 0
+
+    # Blender exposes its own full argv to embedded scripts.
+    sys.argv = ["blender"] + argv[1:]
+    try:
+        runpy.run_path(script, run_name="__main__")
+    except SystemExit as e:
+        return e.code or 0
+    except BaseException as e:  # noqa: BLE001 - mirror --python-exit-code
+        print(f"fake_blender: script failed: {e!r}", file=sys.stderr)
+        return exit_code_on_error
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

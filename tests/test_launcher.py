@@ -1,0 +1,179 @@
+"""Launcher-layer tests against the fake Blender fleet (reference coverage:
+``tests/test_launcher.py:20-112`` — arg wiring, LaunchInfo reconnection,
+CLI app, primaryip; plus blendjax-only failure-detection coverage)."""
+
+import io
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+import zmq
+
+from blendjax import wire
+from blendjax.btt.launch_info import LaunchInfo
+from blendjax.btt.launcher import BlenderLauncher
+from helpers import BLEND_SCRIPTS, FAKE_BLENDER
+
+LAUNCH_SCRIPT = f"{BLEND_SCRIPTS}/launcher.blend.py"
+EXIT_SCRIPT = f"{BLEND_SCRIPTS}/exit.blend.py"
+
+
+@pytest.fixture
+def fake_blender(monkeypatch):
+    monkeypatch.setenv("BLENDJAX_BLENDER", FAKE_BLENDER)
+
+
+def _drain(addresses, n, timeoutms=15000):
+    """Connect a PULL socket to all addresses and fetch n messages."""
+    ctx = zmq.Context()
+    try:
+        sock = ctx.socket(zmq.PULL)
+        for addr in addresses:
+            sock.connect(addr)
+        out = []
+        for _ in range(n):
+            assert sock.poll(timeoutms), "timed out waiting for producer"
+            out.append(wire.recv_message(sock))
+        return out
+    finally:
+        ctx.destroy(linger=0)
+
+
+def test_arg_wiring_two_instances(fake_blender):
+    with BlenderLauncher(
+        scene="",
+        script=LAUNCH_SCRIPT,
+        num_instances=2,
+        named_sockets=["DATA", "CTRL"],
+        start_port=12000,
+        seed=100,
+        background=True,
+        instance_args=[["--extra", "a"], ["--extra", "b"]],
+    ) as bl:
+        info = bl.launch_info
+        assert set(info.addresses) == {"DATA", "CTRL"}
+        assert len(info.addresses["DATA"]) == 2
+        # ports are unique across all sockets/instances
+        all_addrs = [a for addrs in info.addresses.values() for a in addrs]
+        assert len(set(all_addrs)) == 4
+
+        msgs = _drain(info.addresses["DATA"], 2)
+        msgs = sorted(msgs, key=lambda m: m["btid"])
+        for idx, m in enumerate(msgs):
+            assert m["btid"] == idx
+            assert m["btseed"] == 100 + idx
+            assert m["btsockets"]["DATA"] == info.addresses["DATA"][idx]
+            assert m["btsockets"]["CTRL"] == info.addresses["CTRL"][idx]
+            assert m["remainder"] == ["--extra", ["a", "b"][idx]]
+        bl.assert_alive()
+
+
+def test_launch_info_roundtrip(tmp_path):
+    info = LaunchInfo({"DATA": ["tcp://1.2.3.4:11000"]}, ["cmd a"], processes=None)
+    path = tmp_path / "launch_info.json"
+    LaunchInfo.save_json(path, info)
+    restored = LaunchInfo.load_json(path)
+    assert restored.addresses == info.addresses
+    assert restored.commands == info.commands
+
+    # file-like objects (reference bug: NameError on this path)
+    buf = io.StringIO()
+    LaunchInfo.save_json(buf, info)
+    buf.seek(0)
+    assert LaunchInfo.load_json(buf).addresses == info.addresses
+
+
+def test_reconnect_via_launch_info(fake_blender, tmp_path):
+    """Simulates multi-machine: serialize addresses, connect from 'elsewhere'."""
+    with BlenderLauncher(
+        scene="",
+        script=LAUNCH_SCRIPT,
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12100,
+        seed=5,
+        background=True,
+    ) as bl:
+        path = tmp_path / "li.json"
+        LaunchInfo.save_json(path, bl.launch_info)
+        remote = LaunchInfo.load_json(path)
+        (msg,) = _drain(remote.addresses["DATA"], 1)
+        assert msg["btid"] == 0 and msg["btseed"] == 5
+
+
+def test_launch_cli_app(fake_blender, tmp_path):
+    jsonargs = tmp_path / "args.json"
+    jsonargs.write_text(
+        json.dumps(
+            {
+                "scene": "",
+                "script": EXIT_SCRIPT,
+                "num_instances": 2,
+                "named_sockets": ["DATA"],
+                "start_port": 12200,
+                "seed": 1,
+                "background": True,
+            }
+        )
+    )
+    out_info = tmp_path / "launch_info.json"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "blendjax.btt.apps.launch",
+            "--out-launch-info",
+            str(out_info),
+            str(jsonargs),
+        ],
+    )
+    try:
+        deadline = time.time() + 20
+        while not out_info.exists() and time.time() < deadline:
+            time.sleep(0.1)
+        assert out_info.exists(), "launch CLI never wrote launch info"
+        info = LaunchInfo.load_json(str(out_info))
+        msgs = _drain(info.addresses["DATA"], 2)
+        assert {m["btid"] for m in msgs} == {0, 1}
+        assert proc.wait(timeout=20) == 0  # producers exit -> CLI exits
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_primaryip_bind(fake_blender):
+    from blendjax.btt.utils import get_primary_ip
+
+    bl = BlenderLauncher.__new__(BlenderLauncher)
+    bl.bind_addr = "primaryip"
+    bl.proto = "tcp"
+    bl.start_port = 12300
+    bl.num_instances = 1
+    bl.named_sockets = ["DATA"]
+    addrs = bl._addresses()
+    assert get_primary_ip() in addrs["DATA"][0]
+
+
+def test_assert_alive_detects_death(fake_blender):
+    with BlenderLauncher(
+        scene="",
+        script=EXIT_SCRIPT,
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12400,
+        seed=0,
+        background=True,
+    ) as bl:
+        _drain(bl.launch_info.addresses["DATA"], 1)
+        bl.wait()  # producer publishes once then exits
+        with pytest.raises(RuntimeError, match="exit codes"):
+            bl.assert_alive()
+
+
+def test_blender_not_found(monkeypatch, tmp_path):
+    monkeypatch.setenv("BLENDJAX_BLENDER", str(tmp_path / "nope"))
+    with pytest.raises(RuntimeError, match="not found"):
+        BlenderLauncher(scene="", script="x.py")
