@@ -44,6 +44,7 @@ class DataPublisher:
         send_hwm=wire.DEFAULT_HWM,
         raw_buffers=False,
         lingerms=0,
+        sndtimeoms=None,
     ):
         self.btid = btid
         self.raw_buffers = raw_buffers
@@ -52,15 +53,24 @@ class DataPublisher:
         self.sock.setsockopt(zmq.SNDHWM, send_hwm)
         self.sock.setsockopt(zmq.IMMEDIATE, 1)
         self.sock.setsockopt(zmq.LINGER, lingerms)
+        if sndtimeoms is not None:
+            self.sock.setsockopt(zmq.SNDTIMEO, sndtimeoms)
         self.sock.bind(bind_address)
 
     def publish(self, **kwargs):
         """Send one message dict; blocks under backpressure.
 
         ``btid`` is stamped automatically (reference ``publisher.py:41-43``).
+        With ``sndtimeoms`` set, returns False instead of blocking past the
+        timeout (lets an animation loop keep simulating while stalled —
+        blendjax extension, the reference blocks indefinitely).
         """
         data = {wire.BTID_KEY: self.btid, **kwargs}
-        wire.send_message(self.sock, data, raw_buffers=self.raw_buffers)
+        try:
+            wire.send_message(self.sock, data, raw_buffers=self.raw_buffers)
+        except zmq.Again:
+            return False
+        return True
 
     def close(self):
         self.sock.close(0)

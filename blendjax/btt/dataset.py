@@ -1,0 +1,222 @@
+"""Streaming and replay datasets (reference ``btt/dataset.py:14-153``),
+re-designed torch-free.
+
+``RemoteIterableDataset`` pulls message dicts from N Blender producers over
+a fan-in PULL socket (fair-queued across producers, HWM backpressure).  The
+reference couples worker parallelism to ``torch.utils.data`` worker
+processes; blendjax makes the split explicit — ``stream(worker_id,
+num_workers, ...)`` — so any executor (threads in
+:class:`blendjax.btt.loader.BatchLoader`, torch DataLoader workers via the
+compat shim, or one stream per TPU host via ``shard``) can drive it.
+
+Sharding semantics match the reference: each worker yields
+``max_items // num_workers`` items (``dataset.py:97``), generalized to
+``num_shards`` host-level shards for multi-host TPU slices (SURVEY.md §7
+"multi-host sharding semantics").
+"""
+
+from __future__ import annotations
+
+import bisect
+import sys
+from contextlib import ExitStack
+from glob import glob
+
+import zmq
+
+from blendjax import wire
+from blendjax.btt.constants import DEFAULT_TIMEOUTMS
+from blendjax.btt.file import FileReader, FileRecorder
+
+
+def _identity(x):
+    return x
+
+
+def _torch_worker_info():
+    """(worker_id, num_workers) when called inside a torch DataLoader worker.
+
+    Import-free unless torch is already loaded: keeps the consumer package
+    torch-independent while letting reference-style DataLoader use keep
+    working.
+    """
+    utils_data = sys.modules.get("torch.utils.data")
+    if utils_data is None:
+        return None
+    wi = utils_data.get_worker_info()
+    if wi is None:
+        return None
+    return wi.id, wi.num_workers
+
+
+class RemoteIterableDataset:
+    """Iterable over message dicts streamed from remote Blender instances.
+
+    Params
+    ------
+    addresses: list[str]
+        Producer addresses to connect to (fan-in over all of them).
+    queue_size: int
+        RCVHWM; producers stall once this many messages are in flight.
+    timeoutms: int
+        Max silence before :class:`TimeoutError`.
+    max_items: int
+        Artificial dataset length (and recorder capacity).
+    item_transform: callable | None
+        Applied to each received dict.
+    record_path_prefix: str | None
+        When set, worker ``w`` records raw messages to
+        ``{prefix}_{w:02d}.btr`` while streaming.
+    """
+
+    def __init__(
+        self,
+        addresses,
+        queue_size=10,
+        timeoutms=DEFAULT_TIMEOUTMS,
+        max_items=100000,
+        item_transform=None,
+        record_path_prefix=None,
+    ):
+        self.addresses = list(addresses)
+        self.queue_size = queue_size
+        self.timeoutms = timeoutms
+        self.max_items = max_items
+        self.record_path_prefix = record_path_prefix
+        self.item_transform = item_transform or _identity
+
+    def enable_recording(self, fname):
+        """Record while streaming; set before iteration starts."""
+        self.record_path_prefix = fname
+
+    def stream_length(self, max_items):
+        """Set the artificial dataset length."""
+        self.max_items = max_items
+
+    def __iter__(self):
+        wi = _torch_worker_info()
+        if wi is not None:
+            return self.stream(worker_id=wi[0], num_workers=wi[1])
+        return self.stream()
+
+    def stream(
+        self,
+        worker_id=0,
+        num_workers=1,
+        shard_id=0,
+        num_shards=1,
+        stop_event=None,
+    ):
+        """Generator yielding ``max_items // (num_workers * num_shards)``
+        transformed items for this (shard, worker).
+
+        ``stop_event`` (a ``threading.Event``) aborts the stream promptly —
+        the poll loop checks it between messages so loaders can shut down
+        without waiting out ``timeoutms``.
+        """
+        ctx = zmq.Context.instance()
+        socket = ctx.socket(zmq.PULL)
+        socket.setsockopt(zmq.RCVHWM, self.queue_size)
+        socket.setsockopt(zmq.LINGER, 0)
+        try:
+            for addr in self.addresses:
+                socket.connect(addr)
+            poller = zmq.Poller()
+            poller.register(socket, zmq.POLLIN)
+
+            count = self.max_items // (num_workers * num_shards)
+            global_worker = shard_id * num_workers + worker_id
+            with ExitStack() as es:
+                rec = None
+                if self.record_path_prefix is not None:
+                    rec = es.enter_context(
+                        FileRecorder(
+                            FileRecorder.filename(
+                                self.record_path_prefix, global_worker
+                            ),
+                            self.max_items,
+                        )
+                    )
+                for _ in range(count):
+                    waited = 0
+                    slice_ms = 100 if stop_event is not None else self.timeoutms
+                    while True:
+                        if stop_event is not None and stop_event.is_set():
+                            return
+                        if poller.poll(min(slice_ms, self.timeoutms)):
+                            break
+                        waited += slice_ms
+                        if waited >= self.timeoutms:
+                            raise TimeoutError(
+                                f"No message within {self.timeoutms} ms from "
+                                f"{self.addresses}"
+                            )
+                    if rec is not None:
+                        frames = wire.recv_message_raw(socket)
+                        rec.save_frames(frames)
+                        obj = wire.decode_raw_frames(frames)
+                    else:
+                        obj = wire.recv_message(socket)
+                    yield self._item(obj)
+        finally:
+            socket.close(0)
+
+    def _item(self, item):
+        """Override point; defaults to ``item_transform`` (reference
+        ``dataset.py:113-117``)."""
+        return self.item_transform(item)
+
+
+class SingleFileDataset:
+    """Map-style replay of one recording file."""
+
+    def __init__(self, path, item_transform=None):
+        self.reader = FileReader(path)
+        self.item_transform = item_transform or _identity
+
+    def __len__(self):
+        return len(self.reader)
+
+    def __getitem__(self, idx):
+        return self._item(self.reader[idx])
+
+    def _item(self, item):
+        return self.item_transform(item)
+
+
+class FileDataset:
+    """Concatenated replay over all files matching ``{prefix}_*.btr``
+    (reference ``dataset.py:134-153``), map-style so shuffling works."""
+
+    def __init__(self, record_path_prefix, item_transform=None):
+        fnames = sorted(glob(f"{record_path_prefix}_*.btr"))
+        if not fnames:
+            raise FileNotFoundError(
+                f"Found no recording files with prefix {record_path_prefix}"
+            )
+        self.datasets = [SingleFileDataset(f) for f in fnames]
+        self.cum_sizes = []
+        total = 0
+        for ds in self.datasets:
+            total += len(ds)
+            self.cum_sizes.append(total)
+        self.item_transform = item_transform or _identity
+
+    def __len__(self):
+        return self.cum_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        ds_idx = bisect.bisect_right(self.cum_sizes, idx)
+        start = 0 if ds_idx == 0 else self.cum_sizes[ds_idx - 1]
+        return self._item(self.datasets[ds_idx][idx - start])
+
+    def _item(self, item):
+        return self.item_transform(item)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]

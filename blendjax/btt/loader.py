@@ -1,0 +1,154 @@
+"""Threaded batch loader — the torch-DataLoader role in the TPU pipeline
+(replaces reference usage ``DataLoader(ds, batch_size, num_workers=...)``,
+e.g. ``examples/datagen/generate.py``, ``benchmarks/benchmark.py:26``).
+
+Why threads instead of worker processes: the stream's hot path is ZMQ
+``recv`` (GIL released in C) plus numpy buffer handling, so threads overlap
+IO without the serialization tax torch pays to move tensors between worker
+processes.  Each worker thread runs its own PULL socket via
+``RemoteIterableDataset.stream(worker_id, num_workers)`` — identical fan-in
+semantics, zero inter-process copies.
+
+Multi-host TPU slices pass ``shard=(process_index, process_count)`` so the
+global stream is split hosts × workers (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from blendjax.btt.collate import collate as default_collate
+from blendjax.utils.timing import StageTimer
+
+_SENTINEL = object()
+
+
+class BatchLoader:
+    """Iterates collated batches pulled by ``num_workers`` stream threads.
+
+    Params
+    ------
+    dataset: RemoteIterableDataset (or anything with ``.stream(...)``)
+    batch_size: int
+    num_workers: int
+        Stream threads; each takes ``1/num_workers`` of ``max_items``.
+    collate_fn: callable
+        list-of-items -> batch pytree (default numpy collate).
+    shard: (int, int)
+        ``(shard_id, num_shards)`` for host-level splits on TPU pods.
+    drop_last: bool
+        Drop the final partial batch.
+    prefetch_batches: int
+        Bound on buffered items, expressed in batches.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size,
+        num_workers=1,
+        collate_fn=None,
+        shard=(0, 1),
+        drop_last=True,
+        prefetch_batches=2,
+    ):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn or default_collate
+        self.shard = shard
+        self.drop_last = drop_last
+        self.timer = StageTimer()
+        self._queue = queue.Queue(maxsize=max(2, prefetch_batches) * batch_size)
+        self._stop = threading.Event()
+        self._threads = []
+        self._started = False
+
+    def __len__(self):
+        shard_id, num_shards = self.shard
+        per_worker = self.dataset.max_items // (self.num_workers * num_shards)
+        total = per_worker * self.num_workers
+        n, rem = divmod(total, self.batch_size)
+        return n if (self.drop_last or rem == 0) else n + 1
+
+    # -- worker machinery ---------------------------------------------------
+
+    def _worker(self, worker_id):
+        shard_id, num_shards = self.shard
+        try:
+            for item in self.dataset.stream(
+                worker_id=worker_id,
+                num_workers=self.num_workers,
+                shard_id=shard_id,
+                num_shards=num_shards,
+                stop_event=self._stop,
+            ):
+                self._queue.put(item)
+                if self._stop.is_set():
+                    return
+            self._queue.put(_SENTINEL)
+        except BaseException as exc:  # propagate to the consumer thread
+            self._queue.put(exc)
+
+    def _start(self):
+        self._started = True
+        for w in range(self.num_workers):
+            t = threading.Thread(
+                target=self._worker, args=(w,), daemon=True, name=f"bjx-loader-{w}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    def close(self):
+        """Stop worker threads promptly (idempotent)."""
+        self._stop.set()
+        # drain so blocked put() calls can observe the stop flag
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- consumer side ------------------------------------------------------
+
+    def __iter__(self):
+        if self._started:
+            raise RuntimeError(
+                "BatchLoader is single-use; create a new one per epoch/stream"
+            )
+        self._start()
+        finished = 0
+        batch = []
+        try:
+            while finished < self.num_workers:
+                with self.timer.stage("recv"):
+                    item = self._queue.get()
+                if item is _SENTINEL:
+                    finished += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    with self.timer.stage("collate"):
+                        out = self.collate_fn(batch)
+                    batch = []
+                    yield out
+            if batch and not self.drop_last:
+                with self.timer.stage("collate"):
+                    yield self.collate_fn(batch)
+        finally:
+            self.close()

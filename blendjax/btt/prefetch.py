@@ -1,0 +1,172 @@
+"""Device feed: double-buffered host->HBM prefetch.
+
+This module is the TPU-native seam the whole framework exists for
+(BASELINE.json north star): batches coming off the ZMQ stream are staged
+into device memory *while the previous train step runs*, so the TPU never
+waits on the host.  ``jax.device_put`` dispatches asynchronously; keeping
+``size`` batches in flight from a background thread overlaps H2D DMA with
+XLA compute — the reference's equivalent path is torch DataLoader +
+``.to(device)`` inside the train loop, which serializes transfer and step.
+
+Multi-device feeds pass a ``jax.sharding.Sharding`` (e.g. batch split over
+the mesh's 'data' axis); on multi-host slices each process feeds its local
+shard and ``make_array_from_process_local_data`` assembles the global array.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+import numpy as np
+
+from blendjax.utils.timing import StageTimer
+
+_SENTINEL = object()
+
+
+def put_batch(batch, sharding=None):
+    """Place one host batch (numpy pytree) onto device(s).
+
+    With no ``sharding``: default device.  With a sharding on a single-host
+    mesh: ``device_put`` shards directly.  On multi-host meshes the local
+    batch is treated as this process's shard of the global batch.
+    """
+    if sharding is None:
+        return jax.device_put(batch)
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            batch,
+        )
+    return jax.device_put(batch, sharding)
+
+
+def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None):
+    """Wrap ``iterator`` (host batches) into an iterator of device batches.
+
+    Params
+    ------
+    iterator: iterable of numpy pytrees
+    size: int
+        Batches kept in flight (2 = classic double buffering).
+    sharding: jax.sharding.Sharding | None
+        Placement for every leaf (leading-axis batch sharding for DP).
+    transform: callable | None
+        Host-side pre-transfer hook (key selection, dtype cast, layout).
+    timer: StageTimer | None
+        Records ``device_put`` stage times.
+    """
+    if size < 1:
+        raise ValueError("prefetch size must be >= 1")
+    timer = timer or StageTimer()
+    q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def _producer():
+        try:
+            for batch in iterator:
+                if stop.is_set():
+                    return
+                if transform is not None:
+                    batch = transform(batch)
+                with timer.stage("device_put"):
+                    dev_batch = put_batch(batch, sharding)
+                while True:
+                    try:
+                        q.put(dev_batch, timeout=0.5)
+                        break
+                    except queue.Full:
+                        if stop.is_set():
+                            return
+            q.put(_SENTINEL)
+        except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
+            q.put(exc)
+
+    thread = threading.Thread(target=_producer, daemon=True, name="bjx-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(timeout=5)
+
+
+class JaxStream:
+    """End-to-end feed: remote stream -> batches -> device, with timing.
+
+    The one-stop replacement for the reference's
+    ``DataLoader(RemoteIterableDataset(...))`` pattern::
+
+        ds = btt.RemoteIterableDataset(addresses, max_items=...)
+        stream = btt.JaxStream(ds, batch_size=8, num_workers=4,
+                               sharding=data_sharding(mesh))
+        for batch in stream:          # jax.Arrays already in HBM
+            state, loss = train_step(state, batch)
+
+    ``stream.timer.summary()`` exposes recv/collate/device_put stage times;
+    ``stream.duty_cycle(...)`` measures the feed's headroom.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size,
+        num_workers=1,
+        sharding=None,
+        transform=None,
+        prefetch=2,
+        shard=(0, 1),
+        drop_last=True,
+        collate_fn=None,
+    ):
+        from blendjax.btt.loader import BatchLoader
+
+        self.loader = BatchLoader(
+            dataset,
+            batch_size,
+            num_workers=num_workers,
+            shard=shard,
+            drop_last=drop_last,
+            collate_fn=collate_fn,
+        )
+        self.sharding = sharding
+        self.transform = transform
+        self.prefetch = prefetch
+        self.timer = self.loader.timer
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        return device_prefetch(
+            iter(self.loader),
+            size=self.prefetch,
+            sharding=self.sharding,
+            transform=self.transform,
+            timer=self.timer,
+        )
+
+    def close(self):
+        self.loader.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,54 @@
+"""Device-mesh construction helpers.
+
+The reference has no model-side parallelism at all (SURVEY.md §2.4); on TPU
+the training side of every blendjax example scales through one of these
+meshes + ``jax.jit`` with sharding annotations, letting XLA insert the
+collectives over ICI.  Conventions:
+
+- axis ``'data'``  — batch (DP) axis; streams are fed per-host shards.
+- axis ``'model'`` — tensor-parallel axis for wide layers.
+
+``make_mesh({'data': 4, 'model': 2})`` builds a 2-D mesh over the first 8
+local/global devices.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: dict, devices=None) -> Mesh:
+    """Build a mesh with the given ``{axis_name: size}`` layout.
+
+    ``devices`` defaults to ``jax.devices()``; sizes must multiply to at
+    most the device count (extras are left unused).
+    """
+    names = tuple(axes)
+    sizes = tuple(axes.values())
+    need = math.prod(sizes)
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if need > len(devices):
+        raise ValueError(f"mesh {axes} needs {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_mesh(num_devices=None) -> Mesh:
+    """1-D data-parallel mesh over all (or the first N) devices."""
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh({"data": len(devices)}, devices)
+
+
+def data_sharding(mesh: Mesh, axis="data") -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,75 @@
+"""Lightweight per-stage timing — the observability the reference lacks
+(SURVEY.md §5: "The TPU build should add lightweight stage timestamps
+(render / serialize / recv / device_put) since the north-star metric is TPU
+duty-cycle").
+
+Usage::
+
+    timer = StageTimer()
+    with timer.stage("recv"):
+        msg = sock.recv()
+    ...
+    timer.summary()   # {'recv': {'count': n, 'total_s': t, 'mean_ms': m}, ...}
+    timer.duty_cycle("step")   # fraction of wall time inside 'step'
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class StageTimer:
+    """Accumulates wall-clock time per named stage."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total = defaultdict(float)
+        self._count = defaultdict(int)
+        self._start = time.perf_counter()
+
+    @contextmanager
+    def stage(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._total[name] += dt
+            self._count[name] += 1
+
+    def add(self, name, seconds):
+        self._total[name] += seconds
+        self._count[name] += 1
+
+    @property
+    def wall_s(self):
+        return time.perf_counter() - self._start
+
+    def total_s(self, name):
+        return self._total[name]
+
+    def count(self, name):
+        return self._count[name]
+
+    def mean_ms(self, name):
+        c = self._count[name]
+        return (self._total[name] / c) * 1e3 if c else 0.0
+
+    def duty_cycle(self, name):
+        """Fraction of wall time since reset spent inside ``name``."""
+        wall = self.wall_s
+        return self._total[name] / wall if wall > 0 else 0.0
+
+    def summary(self):
+        return {
+            name: {
+                "count": self._count[name],
+                "total_s": round(self._total[name], 6),
+                "mean_ms": round(self.mean_ms(name), 3),
+            }
+            for name in self._total
+        }

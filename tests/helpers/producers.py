@@ -1,0 +1,78 @@
+"""In-process fake producers for consumer-pipeline tests.
+
+Thread-based (not subprocess) because interpreter startup costs ~2s in CI;
+the wire protocol and socket topology are identical to a real Blender
+producer (PUSH bind + SNDHWM + IMMEDIATE via the real DataPublisher).
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import threading
+
+import numpy as np
+
+from blendjax.btb.publisher import DataPublisher
+
+
+def free_port():
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_item(btid, frameid, shape=(16, 16, 3)):
+    img = np.full(shape, (btid * 37 + frameid) % 255, dtype=np.uint8)
+    return {"image": img, "frameid": frameid, "xy": np.array([frameid, btid], np.float32)}
+
+
+class ProducerFleet:
+    """N publisher threads, each streaming items until stopped.
+
+    ``num_items=None`` streams indefinitely (backpressure-limited), matching
+    a Blender fleet with ``num_episodes=-1``.
+    """
+
+    def __init__(self, num_producers=1, num_items=None, shape=(16, 16, 3), raw_buffers=False):
+        self.addresses = [
+            f"tcp://127.0.0.1:{free_port()}" for _ in range(num_producers)
+        ]
+        self.num_items = num_items
+        self.shape = shape
+        self.raw_buffers = raw_buffers
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(num_producers)
+        ]
+
+    def _run(self, btid):
+        pub = DataPublisher(
+            self.addresses[btid],
+            btid=btid,
+            raw_buffers=self.raw_buffers,
+            sndtimeoms=200,
+        )
+        try:
+            frameid = 0
+            while not self._stop.is_set():
+                if self.num_items is not None and frameid >= self.num_items:
+                    break
+                sent = pub.publish(**make_item(btid, frameid, self.shape))
+                if sent:
+                    frameid += 1
+        finally:
+            pub.close()
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        return False

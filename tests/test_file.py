@@ -1,0 +1,127 @@
+"""Record/replay tests: round-trip, capacity, reference-format
+interoperability (both directions), and multi-file FileDataset replay —
+the reference's own suite lacks the multi-file case (SURVEY.md §4)."""
+
+import io
+import pickle
+
+import numpy as np
+import pytest
+
+from blendjax.btt.dataset import FileDataset, SingleFileDataset
+from blendjax.btt.file import FileReader, FileRecorder
+
+
+def _messages(n, btid=0):
+    return [
+        {"image": np.full((4, 4), i + btid, np.uint8), "frameid": i, "btid": btid}
+        for i in range(n)
+    ]
+
+
+def test_roundtrip(tmp_path):
+    path = tmp_path / "rec.btr"
+    msgs = _messages(10)
+    with FileRecorder(path, max_messages=32) as rec:
+        for m in msgs:
+            rec.save(m)
+    reader = FileReader(path)
+    assert len(reader) == 10
+    for i, m in enumerate(msgs):
+        out = reader[i]
+        np.testing.assert_array_equal(out["image"], m["image"])
+        assert out["frameid"] == i
+    # random access out of order
+    assert reader[7]["frameid"] == 7
+    assert reader[2]["frameid"] == 2
+    reader.close()
+
+
+def test_capacity_limit(tmp_path):
+    path = tmp_path / "cap.btr"
+    with FileRecorder(path, max_messages=3) as rec:
+        for m in _messages(10):
+            rec.save(m)
+    assert len(FileReader(path)) == 3
+
+
+def test_prepickled_and_frames(tmp_path):
+    path = tmp_path / "pp.btr"
+    from blendjax import wire
+
+    msg = {"image": np.ones((2, 2), np.uint8), "frameid": 0}
+    raw_multipart = wire.encode(msg, raw_buffers=True)
+    with FileRecorder(path, max_messages=4) as rec:
+        rec.save(pickle.dumps(msg), is_pickled=True)
+        rec.save_frames([pickle.dumps(msg)])
+        rec.save_frames(raw_multipart)
+    reader = FileReader(path)
+    assert len(reader) == 3
+    for i in range(3):
+        np.testing.assert_array_equal(reader[i]["image"], msg["image"])
+
+
+def test_reads_reference_written_file(tmp_path):
+    """A file written exactly the reference way (protocol-3 offsets header
+    rewritten in place, ``file.py:56-74``) must load."""
+    path = tmp_path / "ref.btr"
+    msgs = _messages(5)
+    offsets = np.full(8, -1, dtype=np.int64)
+    with io.open(path, "wb", buffering=0) as f:
+        pickler = pickle.Pickler(f, protocol=3)
+        pickler.dump(offsets)
+        for i, m in enumerate(msgs):
+            offsets[i] = f.tell()
+            pickle.Pickler(f, protocol=3).dump(m)
+        f.seek(0)
+        pickle.Pickler(f, protocol=3).dump(offsets)
+    reader = FileReader(path)
+    assert len(reader) == 5
+    np.testing.assert_array_equal(reader[3]["image"], msgs[3]["image"])
+
+
+def test_reference_can_read_our_file(tmp_path):
+    """Inverse direction: reference-style reading (offsets unpickle + seek)
+    must work on a FileRecorder file."""
+    path = tmp_path / "ours.btr"
+    with FileRecorder(path, max_messages=8) as rec:
+        for m in _messages(4):
+            rec.save(m)
+    with io.open(path, "rb") as f:
+        offsets = pickle.Unpickler(f).load()
+        offsets = offsets[offsets != -1]
+        f.seek(offsets[1])
+        out = pickle.Unpickler(f).load()
+    assert out["frameid"] == 1
+
+
+def test_file_dataset_multifile(tmp_path):
+    prefix = str(tmp_path / "run")
+    for w in range(3):
+        with FileRecorder(FileRecorder.filename(prefix, w), max_messages=8) as rec:
+            for m in _messages(4, btid=w):
+                rec.save(m)
+    ds = FileDataset(prefix)
+    assert len(ds) == 12
+    # ordering: files sorted, indices concatenated
+    assert ds[0]["btid"] == 0 and ds[4]["btid"] == 1 and ds[11]["btid"] == 2
+    assert ds[-1]["frameid"] == 3
+    with pytest.raises(IndexError):
+        ds[12]
+    # transform applies
+    ds2 = FileDataset(prefix, item_transform=lambda d: d["frameid"] * 10)
+    assert ds2[5] == 10
+
+
+def test_single_file_dataset(tmp_path):
+    path = tmp_path / "s.btr"
+    with FileRecorder(path, max_messages=8) as rec:
+        for m in _messages(2):
+            rec.save(m)
+    ds = SingleFileDataset(path, item_transform=lambda d: d["frameid"])
+    assert len(ds) == 2 and ds[1] == 1
+
+
+def test_missing_prefix_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        FileDataset(str(tmp_path / "nope"))
