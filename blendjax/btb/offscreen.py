@@ -1,0 +1,118 @@
+"""Eevee offscreen renderer (reference ``btb/offscreen.py:9-112``).
+
+Renders the first 3D viewport into a ``gpu.types.GPUOffScreen`` and reads
+the color texture back as a numpy uint8 HxWxC array.  Must be called from a
+context where the GL context is valid — i.e. inside the POST_PIXEL draw
+callback that ``AnimationController(use_offline_render=True)`` provides.
+
+Readback strategy, newest first:
+
+1. ``GPUTexture.read()`` (Blender >= 3.0) — returns a ``gpu.types.Buffer``
+   that supports the Python buffer protocol: zero-copy ``np.asarray``.
+2. PyOpenGL ``glGetTexImage`` — the reference's workaround for Blender 2.8x
+   where ``bgl.Buffer`` lacked the buffer protocol
+   (reference ``offscreen.py:85-92``).
+
+Gamma correction: Blender renders linear color.  The reference optionally
+applies ``pow(c, 1/2.2)`` per pixel in numpy on the producer CPU
+(``offscreen.py:105-112``); blendjax defaults to shipping linear frames and
+doing sRGB encode on the TPU via :func:`blendjax.ops.image.linear_to_srgb`,
+where it fuses into the input pipeline for free.  Set ``gamma=True`` for
+reference-compatible producer-side correction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import bpy
+    import gpu
+    from gpu_extras.presets import draw_texture_2d  # noqa: F401 (kept for users)
+except ImportError:  # pragma: no cover - outside Blender
+    bpy = None
+    gpu = None
+
+
+class OffScreenRenderer:
+    """Offscreen Eevee render of the first 3D viewport.
+
+    Params
+    ------
+    camera: blendjax.btb.Camera | None
+        Camera providing view/projection matrices; defaults to scene camera.
+    mode: 'rgb' | 'rgba'
+        Channels of the returned array.
+    origin: 'upper-left' | 'lower-left'
+        Row order of the returned image.
+    gamma: bool
+        Apply producer-side gamma correction (see module docstring).
+    """
+
+    def __init__(self, camera=None, mode="rgb", origin="upper-left", gamma=False):
+        from blendjax.btb.camera import Camera
+        from blendjax.btb.utils import find_first_view3d
+
+        if mode not in ("rgb", "rgba"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.camera = camera or Camera()
+        self.mode = mode
+        self.origin = origin
+        self.gamma = gamma
+        h, w = self.camera.shape
+        self.offscreen = gpu.types.GPUOffScreen(w, h)
+        self.area, self.space, self.region = find_first_view3d()
+        self.shading = None  # set via set_render_style
+
+    def set_render_style(self, shading="RENDERED", overlays=False):
+        """Viewport shading for subsequent renders (reference
+        ``offscreen.py:101-103``)."""
+        self.space.shading.type = shading
+        self.space.overlay.show_overlays = overlays
+
+    def render(self):
+        """Render and return HxWx{3,4} uint8 (reference ``offscreen.py:68-99``)."""
+        h, w = self.camera.shape
+        self.offscreen.draw_view3d(
+            bpy.context.scene,
+            bpy.context.view_layer,
+            self.space,
+            self.region,
+            _as_matrix(self.camera.view_matrix),
+            _as_matrix(self.camera.proj_matrix),
+            do_color_management=self.gamma,
+        )
+        rgba = self._read_texture(w, h)
+        img = rgba[..., :3] if self.mode == "rgb" else rgba
+        if self.origin == "upper-left":
+            img = np.flipud(img)
+        return np.ascontiguousarray(img)
+
+    def _read_texture(self, w, h):
+        tex = getattr(self.offscreen, "texture_color", None)
+        if tex is not None and hasattr(tex, "read"):
+            buf = tex.read()  # gpu.types.Buffer, float32 RGBA in Blender 3.x+
+            arr = np.asarray(buf, dtype=np.float32).reshape(h, w, 4)
+            return (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+        return self._read_texture_gl(w, h)
+
+    def _read_texture_gl(self, w, h):  # pragma: no cover - legacy Blender
+        """PyOpenGL fallback for Blender 2.8x (reference ``offscreen.py:85-92``)."""
+        import bgl
+        from OpenGL.GL import GL_RGBA, GL_TEXTURE_2D, GL_UNSIGNED_BYTE, glGetTexImage
+
+        buffer = np.zeros((h, w, 4), dtype=np.uint8)
+        bgl.glActiveTexture(bgl.GL_TEXTURE0)
+        bgl.glBindTexture(bgl.GL_TEXTURE_2D, self.offscreen.color_texture)
+        glGetTexImage(GL_TEXTURE_2D, 0, GL_RGBA, GL_UNSIGNED_BYTE, buffer)
+        return buffer
+
+    def free(self):
+        self.offscreen.free()
+
+
+def _as_matrix(m):
+    """numpy 4x4 -> mathutils.Matrix for the gpu API."""
+    from mathutils import Matrix
+
+    return Matrix([list(row) for row in np.asarray(m)])

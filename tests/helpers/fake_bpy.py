@@ -1,0 +1,158 @@
+"""A minimal fake ``bpy`` emulating the animation/handler machinery blendjax
+touches, so AnimationController's callback ordering is golden-testable in CI
+(the reference can only test this against real Blender,
+``tests/test_animation.py``).
+
+Faithful behaviors:
+- ``scene.frame_set(f)`` synchronously fires ``frame_change_pre`` then
+  ``frame_change_post`` handler lists (like Blender).
+- ``ops.screen.animation_play()`` only flags playback; the test pumps
+  frames via ``step()`` the way Blender's window manager would, wrapping
+  from frame_end back to frame_start.
+- ``SpaceView3D.draw_handler_add`` registers POST_PIXEL draw callbacks the
+  pump may fire multiple times per frame (to exercise the dedupe guard).
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+
+class _Handlers:
+    def __init__(self):
+        self.frame_change_pre = []
+        self.frame_change_post = []
+
+
+class _PointCache:
+    def __init__(self):
+        self.frame_start = 1
+        self.frame_end = 250
+
+
+class _RigidBodyWorld:
+    def __init__(self):
+        self.point_cache = _PointCache()
+
+
+class _Scene:
+    def __init__(self, bpy):
+        self._bpy = bpy
+        self.frame_start = 1
+        self.frame_end = 250
+        self.frame_current = 1
+        self.rigidbody_world = _RigidBodyWorld()
+
+    def frame_set(self, frame):
+        self.frame_current = frame
+        for h in list(self._bpy.app.handlers.frame_change_pre):
+            h(self)
+        for h in list(self._bpy.app.handlers.frame_change_post):
+            h(self)
+
+
+class _Region:
+    type = "WINDOW"
+    width = 1920
+
+
+class _SpaceData:
+    type = "VIEW_3D"
+
+    def __init__(self):
+        pass
+
+
+class _Area:
+    type = "VIEW_3D"
+
+    def __init__(self, space):
+        self.regions = [_Region()]
+        self.spaces = [space]
+
+
+class _Screen:
+    def __init__(self, space):
+        self.areas = [_Area(space)]
+
+
+class _SpaceView3DType:
+    """Class-level draw handler registry, like bpy.types.SpaceView3D."""
+
+    _handlers = []
+
+    @classmethod
+    def draw_handler_add(cls, fn, args, region_type, event):
+        handle = (fn, args, region_type, event)
+        cls._handlers.append(handle)
+        return handle
+
+    @classmethod
+    def draw_handler_remove(cls, handle, region_type):
+        cls._handlers.remove(handle)
+
+
+class _Ops:
+    def __init__(self, bpy):
+        self._bpy = bpy
+        self.screen = types.SimpleNamespace(
+            animation_play=self._play, animation_cancel=self._cancel
+        )
+
+    def _play(self):
+        self._bpy._animation_running = True
+
+    def _cancel(self, restore_frame=False):
+        self._bpy._animation_running = False
+
+
+class FakeBpy(types.ModuleType):
+    """Install with ``install()`` before importing blendjax.btb.animation."""
+
+    def __init__(self):
+        super().__init__("bpy")
+        self.app = types.SimpleNamespace(handlers=_Handlers())
+        space = _SpaceData()
+        scene = _Scene(self)
+        self.context = types.SimpleNamespace(
+            scene=scene,
+            screen=_Screen(space),
+            space_data=space,
+        )
+        self.types = types.SimpleNamespace(SpaceView3D=_SpaceView3DType)
+        self.ops = _Ops(self)
+        self._animation_running = False
+        _SpaceView3DType._handlers = []
+
+    # -- test pump ----------------------------------------------------------
+
+    def pump_frame(self, draws_per_frame=1):
+        """Advance one frame the way Blender's player would: wrap at range
+        end, fire frame handlers, then fire draw handlers (possibly more
+        than once, as real POST_PIXEL does)."""
+        if not self._animation_running:
+            return False
+        scene = self.context.scene
+        nxt = scene.frame_current + 1
+        if nxt > scene.frame_end:
+            nxt = scene.frame_start
+        # frame_set fires pre+post frame-change handlers
+        scene.frame_set(nxt)
+        self.pump_draw(draws_per_frame)
+        return True
+
+    def pump_draw(self, times=1):
+        for _ in range(times):
+            for fn, args, _, _ in list(_SpaceView3DType._handlers):
+                fn(*args)
+
+
+def install():
+    """Install a fresh FakeBpy into sys.modules and purge cached blendjax
+    modules that bound the previous instance.  Returns the fake."""
+    fake = FakeBpy()
+    sys.modules["bpy"] = fake
+    for name in ("blendjax.btb.animation", "blendjax.btb.utils", "blendjax.btb.camera"):
+        sys.modules.pop(name, None)
+    return fake

@@ -1,0 +1,133 @@
+"""Golden callback-order tests for AnimationController over fake bpy
+(reference coverage: ``tests/test_animation.py:7-51`` asserts the exact
+sequence over 2 episodes x 3 frames in background and UI modes — but needs
+real Blender and swallows exceptions vacuously; this is the CI-safe
+version)."""
+
+import pytest
+
+from helpers import fake_bpy
+
+
+def _wire(controller, log):
+    controller.pre_play.add(lambda: log.append("pre_play"))
+    controller.pre_animation.add(lambda: log.append("pre_anim"))
+    controller.pre_frame.add(lambda: log.append(f"pre_{controller.frameid}"))
+    controller.post_frame.add(lambda: log.append(f"post_{controller.frameid}"))
+    controller.post_animation.add(lambda: log.append("post_anim"))
+    controller.post_play.add(lambda: log.append("post_play"))
+
+
+GOLDEN = (
+    ["pre_play"]
+    + ["pre_anim", "pre_1", "post_1", "pre_2", "post_2", "pre_3", "post_3", "post_anim"]
+    + ["pre_anim", "pre_1", "post_1", "pre_2", "post_2", "pre_3", "post_3", "post_anim"]
+    + ["post_play"]
+)
+
+
+def test_blocking_mode_golden_sequence():
+    bpy = fake_bpy.install()
+    from blendjax.btb.animation import AnimationController
+
+    ctrl = AnimationController()
+    log = []
+    _wire(ctrl, log)
+    ctrl.play(frame_range=(1, 3), num_episodes=2, use_animation=False)
+    assert log == GOLDEN
+    assert not ctrl.playing
+    # handlers fully unregistered
+    assert not bpy.app.handlers.frame_change_pre
+    assert not bpy.app.handlers.frame_change_post
+
+
+@pytest.mark.parametrize("draws_per_frame", [1, 3])
+def test_ui_mode_golden_sequence_with_post_pixel_dedupe(draws_per_frame):
+    bpy = fake_bpy.install()
+    from blendjax.btb.animation import AnimationController
+
+    ctrl = AnimationController()
+    log = []
+    _wire(ctrl, log)
+    ctrl.play(
+        frame_range=(1, 3),
+        num_episodes=2,
+        use_animation=True,
+        use_offline_render=True,
+    )
+    bpy.pump_draw(draws_per_frame)  # draws for the first frame
+    for _ in range(32):  # more pumps than needed; play stops itself
+        if not bpy.pump_frame(draws_per_frame):
+            break
+    assert log == GOLDEN
+    assert not ctrl.playing
+    assert not bpy._animation_running  # animation_cancel called
+    assert not bpy.types.SpaceView3D._handlers  # draw handler removed
+
+
+def test_ui_mode_without_offline_render_uses_frame_change_post():
+    bpy = fake_bpy.install()
+    from blendjax.btb.animation import AnimationController
+
+    ctrl = AnimationController()
+    log = []
+    _wire(ctrl, log)
+    ctrl.play(
+        frame_range=(1, 2),
+        num_episodes=1,
+        use_animation=True,
+        use_offline_render=False,
+    )
+    # frame 1 pre+post fired synchronously by frame_set inside play
+    while bpy.pump_frame():
+        pass
+    assert log == [
+        "pre_play", "pre_anim", "pre_1", "post_1", "pre_2", "post_2",
+        "post_anim", "post_play",
+    ]
+
+
+def test_infinite_episodes_and_stop():
+    bpy = fake_bpy.install()
+    from blendjax.btb.animation import AnimationController
+
+    ctrl = AnimationController()
+    log = []
+    _wire(ctrl, log)
+    ctrl.play(frame_range=(1, 2), num_episodes=-1, use_animation=True,
+              use_offline_render=False)
+    for _ in range(20):
+        bpy.pump_frame()
+    assert ctrl.playing  # still going
+    episodes = log.count("post_anim")
+    assert episodes >= 4
+    ctrl.stop()
+    assert log[-1] == "post_play"
+    assert not ctrl.playing
+    # double stop is a no-op
+    ctrl.stop()
+    assert log.count("post_play") == 1
+
+
+def test_frame_range_and_physics_sync():
+    bpy = fake_bpy.install()
+    from blendjax.btb.animation import AnimationController
+
+    rng = AnimationController.setup_frame_range((5, 9))
+    assert rng == (5, 9)
+    assert bpy.context.scene.frame_start == 5
+    assert bpy.context.scene.frame_end == 9
+    cache = bpy.context.scene.rigidbody_world.point_cache
+    assert (cache.frame_start, cache.frame_end) == (5, 9)
+
+
+def test_play_twice_raises():
+    fake_bpy.install()
+    from blendjax.btb.animation import AnimationController
+
+    ctrl = AnimationController()
+    ctrl.play(frame_range=(1, 2), num_episodes=-1, use_animation=True,
+              use_offline_render=False)
+    with pytest.raises(RuntimeError, match="already running"):
+        ctrl.play()
+    ctrl.stop()

@@ -1,0 +1,94 @@
+"""Golden-value projection tests (reference coverage:
+``tests/test_camera.py:10-49`` asserts ortho + perspective pixel coords and
+depths against a checked-in scene; blendjax's math core is pure so the
+goldens are computed against analytically-known matrices instead)."""
+
+import numpy as np
+import pytest
+
+from blendjax.btb import camera_math as cm
+
+# Camera 5 units along -Y, looking at the origin, +Z up.
+EYE = (0.0, -5.0, 0.0)
+VIEW = cm.look_at_matrix(EYE, (0, 0, 0))
+SHAPE = (64, 64)  # H, W
+
+
+def test_look_at_frame():
+    # origin maps 5 units in front of the camera (camera looks down -Z)
+    cam = cm.hom(np.array([[0.0, 0.0, 0.0]])) @ VIEW.T
+    np.testing.assert_allclose(cam[0, :3], [0, 0, -5], atol=1e-12)
+    # +Z world is up in camera coords
+    up = cm.hom(np.array([[0.0, 0.0, 1.0]])) @ VIEW.T
+    assert up[0, 1] > 0
+
+
+def test_perspective_projection_golden():
+    proj = cm.perspective_projection(np.pi / 2, 1.0, 0.1, 100.0)  # fov 90°
+    # center point -> image center
+    px = cm.project_points([[0, 0, 0]], VIEW, proj, SHAPE)
+    np.testing.assert_allclose(px, [[32, 32]], atol=1e-9)
+    # x=+1 world at depth 5 with f=1 -> ndc x 0.2 -> pixel 38.4
+    px, z = cm.project_points([[1, 0, 0]], VIEW, proj, SHAPE, return_depth=True)
+    np.testing.assert_allclose(px, [[38.4, 32.0]], atol=1e-9)
+    np.testing.assert_allclose(z, [5.0], atol=1e-12)
+    # z=+1 world -> up in image -> smaller row index with upper-left origin
+    px_up = cm.project_points([[0, 0, 1]], VIEW, proj, SHAPE)
+    assert px_up[0, 1] < 32
+    px_up_gl = cm.project_points([[0, 0, 1]], VIEW, proj, SHAPE, origin="lower-left")
+    assert px_up_gl[0, 1] > 32
+    np.testing.assert_allclose(px_up[0, 1] + px_up_gl[0, 1], 64.0, atol=1e-9)
+
+
+def test_orthographic_projection_golden():
+    proj = cm.orthographic_projection(4.0, 1.0, 0.1, 100.0)  # half width 2
+    px = cm.project_points([[1, 0, 0]], VIEW, proj, SHAPE)
+    np.testing.assert_allclose(px, [[48.0, 32.0]], atol=1e-9)  # ndc 0.5
+    # depth invariant to x under ortho
+    _, z = cm.world_to_ndc([[1.5, 0, 0]], VIEW, proj, return_depth=True)
+    np.testing.assert_allclose(z, [5.0], atol=1e-12)
+
+
+def test_hom_dehom_roundtrip():
+    pts = np.array([[1.0, 2.0, 3.0], [-4.0, 0.5, 2.0]])
+    h = cm.hom(pts)
+    assert h.shape == (2, 4)
+    np.testing.assert_allclose(cm.dehom(h), pts)
+    h2 = cm.hom(pts, 2.0)
+    np.testing.assert_allclose(cm.dehom(h2), pts / 2.0)
+
+
+def test_ndc_to_pixel_origins():
+    ndc = np.array([[0.0, 0.5, 0.0]])
+    ul = cm.ndc_to_pixel(ndc, SHAPE, "upper-left")
+    ll = cm.ndc_to_pixel(ndc, SHAPE, "lower-left")
+    np.testing.assert_allclose(ul, [[32.0, 16.0]])
+    np.testing.assert_allclose(ll, [[32.0, 48.0]])
+    with pytest.raises(ValueError):
+        cm.ndc_to_pixel(ndc, SHAPE, "center")
+
+
+def test_bbox_corners():
+    corners = cm.bbox_corners([0, 0, 0], [1, 2, 3])
+    assert corners.shape == (8, 3)
+    np.testing.assert_allclose(corners.min(0), [0, 0, 0])
+    np.testing.assert_allclose(corners.max(0), [1, 2, 3])
+
+
+def test_random_spherical_loc():
+    rng = np.random.default_rng(0)
+    pts = np.stack(
+        [cm.random_spherical_loc(radius_range=(2, 3), rng=rng) for _ in range(64)]
+    )
+    radii = np.linalg.norm(pts, axis=1)
+    assert (radii >= 2 - 1e-9).all() and (radii <= 3 + 1e-9).all()
+    # reproducible under the same seed
+    a = cm.random_spherical_loc(rng=np.random.default_rng(7))
+    b = cm.random_spherical_loc(rng=np.random.default_rng(7))
+    np.testing.assert_allclose(a, b)
+
+
+def test_degenerate_look_at_along_up():
+    view = cm.look_at_matrix((0, 0, 5), (0, 0, 0))  # looking along -up
+    cam = cm.hom(np.array([[0.0, 0.0, 0.0]])) @ view.T
+    np.testing.assert_allclose(cam[0, :3], [0, 0, -5], atol=1e-12)
