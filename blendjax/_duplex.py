@@ -1,0 +1,85 @@
+"""Bidirectional PAIR-socket control channel, shared implementation.
+
+The reference ships two near-identical copies (``pkg_pytorch/.../duplex.py``
+and ``pkg_blender/.../duplex.py``, differing only in bind-vs-connect at
+line 18); blendjax keeps one class and lets each side pick its role:
+producer (Blender) binds, consumer connects.
+
+This is the control plane that lets a training loop push new simulation
+parameters into running Blender instances mid-training (the densityopt
+workflow, reference ``examples/densityopt/densityopt.py:95-107``).
+"""
+
+from __future__ import annotations
+
+import zmq
+
+from blendjax import wire
+
+
+class DuplexChannelBase:
+    """PAIR socket with HWM-10 queues and send/recv timeouts.
+
+    Params
+    ------
+    address: str
+        ZMQ endpoint.
+    btid: int | None
+        Instance id stamped into outgoing messages.
+    bind: bool
+        Bind (producer side) instead of connect (consumer side).
+    lingerms / timeoutms: int
+        Socket teardown / send+recv timeouts.
+    raw_buffers: bool
+        Zero-copy multipart encoding for ndarray payloads.
+    """
+
+    def __init__(
+        self,
+        address,
+        btid=None,
+        bind=False,
+        lingerms=0,
+        timeoutms=None,
+        raw_buffers=False,
+    ):
+        if timeoutms is None:
+            timeoutms = self.DEFAULT_TIMEOUTMS
+        self.btid = btid
+        self.raw_buffers = raw_buffers
+        self._ctx = zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.PAIR)
+        self.sock.setsockopt(zmq.LINGER, lingerms)
+        self.sock.setsockopt(zmq.RCVHWM, wire.DEFAULT_HWM)
+        self.sock.setsockopt(zmq.SNDHWM, wire.DEFAULT_HWM)
+        self.sock.setsockopt(zmq.SNDTIMEO, timeoutms)
+        self.sock.setsockopt(zmq.RCVTIMEO, timeoutms)
+        if bind:
+            self.sock.bind(address)
+        else:
+            self.sock.connect(address)
+        self.poller = zmq.Poller()
+        self.poller.register(self.sock, zmq.POLLIN)
+
+    DEFAULT_TIMEOUTMS = 10000
+
+    def recv(self, timeoutms=None):
+        """Next message dict, or None when ``timeoutms`` elapses.
+
+        ``timeoutms=None`` blocks; ``0`` polls non-blocking (the producer's
+        per-frame pattern, reference ``supershape.blend.py:26-37``).
+        """
+        if self.poller.poll(timeoutms):
+            return wire.recv_message(self.sock)
+        return None
+
+    def send(self, **kwargs):
+        """Send a message; stamps ``btid`` and a fresh ``btmid`` and returns
+        the ``btmid`` for correlating replies (reference ``duplex.py:44-67``)."""
+        mid = wire.new_message_id()
+        data = {wire.BTID_KEY: self.btid, wire.BTMID_KEY: mid, **kwargs}
+        wire.send_message(self.sock, data, raw_buffers=self.raw_buffers)
+        return mid
+
+    def close(self):
+        self.sock.close(0)

@@ -1,0 +1,21 @@
+"""Producer-side duplex channel (reference ``btb/duplex.py``): binds the
+PAIR socket inside Blender; the consumer connects."""
+
+from __future__ import annotations
+
+from blendjax._duplex import DuplexChannelBase
+from blendjax.btb.constants import DEFAULT_TIMEOUTMS
+
+
+class DuplexChannel(DuplexChannelBase):
+    DEFAULT_TIMEOUTMS = DEFAULT_TIMEOUTMS
+
+    def __init__(self, address, btid=None, lingerms=0, timeoutms=None, raw_buffers=False):
+        super().__init__(
+            address,
+            btid=btid,
+            bind=True,
+            lingerms=lingerms,
+            timeoutms=timeoutms,
+            raw_buffers=raw_buffers,
+        )

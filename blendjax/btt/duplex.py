@@ -1,0 +1,21 @@
+"""Consumer-side duplex channel (reference ``btt/duplex.py:8-67``):
+connects to the producer's bound PAIR socket."""
+
+from __future__ import annotations
+
+from blendjax._duplex import DuplexChannelBase
+from blendjax.btt.constants import DEFAULT_TIMEOUTMS
+
+
+class DuplexChannel(DuplexChannelBase):
+    DEFAULT_TIMEOUTMS = DEFAULT_TIMEOUTMS
+
+    def __init__(self, address, btid=None, lingerms=0, timeoutms=None, raw_buffers=False):
+        super().__init__(
+            address,
+            btid=btid,
+            bind=False,
+            lingerms=lingerms,
+            timeoutms=timeoutms,
+            raw_buffers=raw_buffers,
+        )

@@ -1,0 +1,26 @@
+"""Producer fixture: echoes each received duplex message back with its
+btmid, then sends an 'end' marker after N echoes (mirrors the reference
+fixture ``tests/blender/duplex.blend.py:9-11``)."""
+
+import argparse
+
+from blendjax.btb.arguments import parse_blendtorch_args
+from blendjax.btb.duplex import DuplexChannel
+
+
+def main():
+    btargs, remainder = parse_blendtorch_args()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--necho", type=int, default=2)
+    args = parser.parse_args(remainder)
+
+    duplex = DuplexChannel(btargs.btsockets["CTRL"], btid=btargs.btid)
+    for _ in range(args.necho):
+        msg = duplex.recv(timeoutms=20000)
+        if msg is None:
+            return
+        duplex.send(echo=msg["payload"], got_mid=msg["btmid"])
+    duplex.send(marker="end")
+
+
+main()
