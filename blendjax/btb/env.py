@@ -1,0 +1,201 @@
+"""Producer-side environment layer (reference ``btb/env.py:10-252``).
+
+``BaseEnv`` is the gym.Env analog for Blender: because Blender's animation
+system is the event loop, an env implements three hooks instead of one
+``step``:
+
+- ``_env_reset()``              — restore initial state (pre_animation)
+- ``_env_prepare_step(action)`` — apply the action *before* the frame so
+  physics integrates it (pre_frame; rationale reference ``env.py:144-159``)
+- ``_env_post_step()``          — gather ``{obs, reward, done, ...}`` after
+  the frame completed (post_frame)
+
+``RemoteControlledAgent`` bridges this callback world to a blocking
+remote ``step()/reset()`` peer (:class:`blendjax.btt.env.RemoteEnv`) via a
+REP socket: one remote ``step()`` == one simulated frame.  With
+``real_time=True`` the socket goes non-blocking and simulation time
+advances even when the agent is slow (missed frames step with no action).
+
+Module import needs no bpy; only instantiating ``BaseEnv`` touches the
+animation system, so the RPC state machine is unit-testable in CI.
+"""
+
+from __future__ import annotations
+
+import zmq
+
+from blendjax import wire
+from blendjax.btb.constants import DEFAULT_TIMEOUTMS
+
+
+class BaseEnv:
+    """Abstract Blender environment driven by animation callbacks.
+
+    Params
+    ------
+    agent: callable
+        ``cmd, action = agent(env, **ctx)`` invoked each frame (from the
+        second frame of an episode on); ``ctx`` holds at least
+        ``obs/reward/done/prev_action/time``.
+    """
+
+    STATE_INIT = "init"
+    STATE_RUN = "run"
+    CMD_RESTART = "restart"
+    CMD_STEP = "step"
+
+    def __init__(self, agent):
+        from blendjax.btb.animation import AnimationController
+
+        self.events = AnimationController()
+        self.events.pre_animation.add(self._pre_animation)
+        self.events.pre_frame.add(self._pre_frame)
+        self.events.post_frame.add(self._post_frame)
+        self.agent = agent
+        self.ctx = None
+        self.renderer = None
+        self.render_every = None
+        self.frame_range = None
+        self.state = BaseEnv.STATE_INIT
+
+    def run(self, frame_range=None, use_animation=True):
+        """Enter the env loop.  The playback range end is pinned far past
+        the scene range so episodes may outlive it (reference ``env.py:74``);
+        ``frame_range`` only determines the ``done`` horizon."""
+        from blendjax.btb.animation import AnimationController
+
+        self.frame_range = AnimationController.setup_frame_range(frame_range)
+        self.events.play(
+            (self.frame_range[0], 2147483647),
+            num_episodes=-1,
+            use_animation=use_animation,
+            use_offline_render=True,
+        )
+
+    def attach_default_renderer(self, every_nth=1):
+        """Render every nth frame into ``ctx['rgb_array']`` for remote
+        ``env.render()`` (reference ``env.py:79-95``)."""
+        from blendjax.btb.camera import Camera
+        from blendjax.btb.offscreen import OffScreenRenderer
+
+        self.renderer = OffScreenRenderer(camera=Camera(), mode="rgb", gamma=True)
+        self.render_every = every_nth
+
+    # -- animation callbacks ------------------------------------------------
+
+    def _pre_animation(self):
+        self.state = BaseEnv.STATE_INIT
+        self.ctx = {"prev_action": None, "done": False}
+        self._env_reset()
+
+    def _pre_frame(self):
+        self.ctx["time"] = self.events.frameid
+        self.ctx["done"] |= self.events.frameid >= self.frame_range[1]
+        if self.events.frameid > self.frame_range[0]:
+            cmd, action = self.agent(self, **self.ctx)
+            if cmd == BaseEnv.CMD_RESTART:
+                self._restart()
+            elif cmd == BaseEnv.CMD_STEP:
+                if action is not None:
+                    self._env_prepare_step(action)
+                    self.ctx["prev_action"] = action
+                self.state = BaseEnv.STATE_RUN
+
+    def _post_frame(self):
+        self._render(self.ctx)
+        self.ctx = {**self.ctx, **self._env_post_step()}
+
+    def _render(self, ctx):
+        if self.renderer is not None:
+            offset = self.events.frameid - self.frame_range[0]
+            if offset % self.render_every == 0:
+                ctx["rgb_array"] = self.renderer.render()
+
+    def _restart(self):
+        self.events.rewind()
+
+    # -- to be implemented by concrete envs ---------------------------------
+
+    def _env_reset(self):
+        """Reset state to initial; returns nothing."""
+        raise NotImplementedError
+
+    def _env_prepare_step(self, action):
+        """Apply ``action`` before the frame simulates."""
+        raise NotImplementedError
+
+    def _env_post_step(self):
+        """Return ``{obs, reward, ...}`` (and optionally ``done``) after the
+        frame completed."""
+        raise NotImplementedError
+
+
+class RemoteControlledAgent:
+    """REP-socket agent: requests from a remote peer drive the env.
+
+    State machine per frame callback (reference ``env.py:206-252``):
+    in REP state, send the previous frame's ctx (the reply to the last
+    RPC); then in REQ state, receive ``{cmd: 'reset'|'step', action}`` and
+    translate to ``CMD_RESTART``/``CMD_STEP``.  A ``reset`` arriving while
+    the env is already freshly reset recurses to serve the follow-up
+    request immediately (so remote ``reset()`` returns the initial obs
+    without consuming a frame).
+
+    Params
+    ------
+    address: str
+        Endpoint to bind (from ``-btsockets GYM=...``).
+    real_time: bool
+        Non-blocking mode: simulation never waits; missed exchanges step
+        with ``action=None``.
+    timeoutms: int
+        Socket send/recv timeout.
+    """
+
+    STATE_REQ = "await_request"
+    STATE_REP = "send_reply"
+
+    def __init__(self, address, real_time=False, timeoutms=DEFAULT_TIMEOUTMS):
+        self._ctx = zmq.Context.instance()
+        self.socket = self._ctx.socket(zmq.REP)
+        self.socket.setsockopt(zmq.LINGER, 0)
+        self.socket.setsockopt(zmq.SNDTIMEO, timeoutms)
+        self.socket.setsockopt(zmq.RCVTIMEO, timeoutms)
+        self.socket.bind(address)
+        self.real_time = real_time
+        self.state = RemoteControlledAgent.STATE_REQ
+
+    def __call__(self, env, **ctx):
+        flags = 0
+        if self.real_time and env.state == BaseEnv.STATE_RUN:
+            flags = zmq.NOBLOCK
+
+        if self.state == RemoteControlledAgent.STATE_REP:
+            try:
+                wire.send_message(self.socket, ctx, flags=flags)
+                self.state = RemoteControlledAgent.STATE_REQ
+            except zmq.Again:
+                if not self.real_time:
+                    raise TimeoutError("Failed to send reply to remote agent.")
+                return BaseEnv.CMD_STEP, None
+
+        try:
+            request = self.socket.recv(flags=flags)
+        except zmq.Again:
+            return BaseEnv.CMD_STEP, None
+        request = wire.loads(request)
+        cmd_name = request.get("cmd")
+        if cmd_name not in ("reset", "step"):
+            raise ValueError(f"unknown remote command {cmd_name!r}")
+        self.state = RemoteControlledAgent.STATE_REP
+
+        if cmd_name == "reset":
+            if env.state == BaseEnv.STATE_INIT:
+                # Already reset: reply with the fresh ctx and serve the
+                # follow-up request in the same frame.
+                return self.__call__(env, **ctx)
+            return BaseEnv.CMD_RESTART, None
+        return BaseEnv.CMD_STEP, request.get("action")
+
+    def close(self):
+        self.socket.close(0)

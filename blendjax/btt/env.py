@@ -1,0 +1,201 @@
+"""Consumer-side remote environment (reference ``btt/env.py:7-316``).
+
+``RemoteEnv`` gives the familiar blocking ``step()/reset()`` over a REQ
+socket whose peer is a :class:`blendjax.btb.env.RemoteControlledAgent`
+inside Blender.  One ``step()`` == one simulated frame.  Observations come
+back as numpy-friendly pytrees, ready for ``jax.device_put`` — for batched
+policy training over many instances use :class:`blendjax.btt.envpool.EnvPool`.
+
+``REQ_RELAXED`` + ``REQ_CORRELATE`` keep the REQ socket usable after a
+timeout (no strict alternation lockup), matching the reference
+(``btt/env.py:40-41``).
+"""
+
+from __future__ import annotations
+
+from contextlib import ExitStack, contextmanager
+
+import zmq
+
+from blendjax import wire
+from blendjax.btt.constants import DEFAULT_TIMEOUTMS
+
+
+class RemoteEnv:
+    """Blocking client for one remote Blender environment."""
+
+    def __init__(self, address, timeoutms=DEFAULT_TIMEOUTMS):
+        self._ctx = zmq.Context.instance()
+        self.socket = self._ctx.socket(zmq.REQ)
+        self.socket.setsockopt(zmq.LINGER, 0)
+        self.socket.setsockopt(zmq.SNDTIMEO, timeoutms * 10)
+        self.socket.setsockopt(zmq.RCVTIMEO, timeoutms)
+        self.socket.setsockopt(zmq.REQ_RELAXED, 1)
+        self.socket.setsockopt(zmq.REQ_CORRELATE, 1)
+        self.socket.connect(address)
+        self.env_time = None
+        self.rgb_array = None
+        self.viewer = None
+
+    def reset(self):
+        """Reset; returns ``(obs, info)`` (reference ``btt/env.py:47-60``)."""
+        ddict = self._reqrep(cmd="reset")
+        self.rgb_array = ddict.pop("rgb_array", None)
+        return ddict.pop("obs"), ddict
+
+    def step(self, action):
+        """Apply ``action``; returns ``(obs, reward, done, info)``.
+
+        ``action`` must be wire-serializable (numbers, numpy arrays,
+        nested containers thereof).
+        """
+        ddict = self._reqrep(cmd="step", action=action)
+        obs = ddict.pop("obs")
+        reward = ddict.pop("reward")
+        done = ddict.pop("done")
+        self.rgb_array = ddict.pop("rgb_array", None)
+        return obs, reward, done, ddict
+
+    def render(self, mode="human", backend=None):
+        """Show (or return) the last frame rendered by the remote env's
+        attached renderer (reference ``btt/env.py:88-109``)."""
+        if mode == "rgb_array" or self.rgb_array is None:
+            return self.rgb_array
+        if self.viewer is None:
+            from blendjax.btt.env_rendering import create_renderer
+
+            self.viewer = create_renderer(backend)
+        self.viewer.imshow(self.rgb_array)
+        return None
+
+    def _reqrep(self, **send_kwargs):
+        try:
+            wire.send_message(self.socket, {**send_kwargs, "time": self.env_time})
+        except zmq.Again:
+            raise TimeoutError("Failed to send to remote environment") from None
+        try:
+            ddict = wire.recv_message(self.socket)
+        except zmq.Again:
+            raise TimeoutError("No response from remote environment") from None
+        self.env_time = ddict["time"]
+        return ddict
+
+    def close(self):
+        if self.viewer is not None:
+            self.viewer.close()
+            self.viewer = None
+        if self.socket is not None:
+            self.socket.close(0)
+            self.socket = None
+
+
+def kwargs_to_cli(kwargs):
+    """Python kwargs -> CLI flags for the remote env script: ``k=v`` becomes
+    ``--k v``; booleans become ``--k`` / ``--no-k``; underscores become
+    dashes (reference ``btt/env.py:162-173``)."""
+    args = []
+    for key, value in kwargs.items():
+        key = key.replace("_", "-")
+        if isinstance(value, bool):
+            args.append(f"--{key}" if value else f"--no-{key}")
+        else:
+            args.extend([f"--{key}", str(value)])
+    return args
+
+
+@contextmanager
+def launch_env(scene, script, background=False, timeoutms=DEFAULT_TIMEOUTMS, **kwargs):
+    """Launch one Blender env instance and yield a connected RemoteEnv
+    (reference ``btt/env.py:136-189``).  Extra kwargs become CLI flags for
+    the env script (see :func:`kwargs_to_cli`)."""
+    from blendjax.btt.launcher import BlenderLauncher
+
+    env = None
+    try:
+        with BlenderLauncher(
+            scene=scene,
+            script=script,
+            num_instances=1,
+            named_sockets=["GYM"],
+            instance_args=[kwargs_to_cli(kwargs)],
+            background=background,
+        ) as bl:
+            env = RemoteEnv(bl.launch_info.addresses["GYM"][0], timeoutms=timeoutms)
+            yield env
+    finally:
+        if env is not None:
+            env.close()
+
+
+def _gym_module():
+    try:
+        import gymnasium
+
+        return gymnasium
+    except ImportError:
+        pass
+    try:
+        import gym
+
+        return gym
+    except ImportError:
+        return None
+
+
+_gym = _gym_module()
+
+if _gym is not None:
+
+    class OpenAIRemoteEnv(_gym.Env):
+        """gym/gymnasium adapter over :func:`launch_env`
+        (reference ``btt/env.py:195-313``).  Subclass, call
+        :meth:`launch` with your scene/script, and register with gym."""
+
+        metadata = {"render.modes": ["rgb_array", "human"]}
+
+        def __init__(self, version="0.0.1"):
+            self.__version__ = version
+            self._es = ExitStack()
+            self._env = None
+
+        def launch(self, scene, script, background=False, **kwargs):
+            if self._env is not None:
+                raise RuntimeError("Environment already running.")
+            self._env = self._es.enter_context(
+                launch_env(scene=scene, script=script, background=background, **kwargs)
+            )
+
+        def step(self, action):
+            obs, reward, done, info = self._env.step(action)
+            return obs, reward, done, info
+
+        def reset(self):
+            obs, _ = self._env.reset()
+            return obs
+
+        def render(self, mode="human"):
+            return self._env.render(mode=mode)
+
+        @property
+        def env_time(self):
+            return self._env.env_time
+
+        def close(self):
+            if self._es is not None:
+                self._es.close()
+                self._es = None
+                self._env = None
+
+        def __del__(self):
+            self.close()
+
+else:  # pragma: no cover - gym not installed
+
+    class OpenAIRemoteEnv:  # noqa: D401 - stub
+        """Placeholder raising on use: neither gym nor gymnasium installed."""
+
+        def __init__(self, *a, **k):
+            raise ImportError(
+                "OpenAIRemoteEnv requires gym or gymnasium; "
+                "use RemoteEnv / EnvPool for the jax-native interface."
+            )

@@ -1,0 +1,59 @@
+"""Pluggable viewers for ``RemoteEnv.render(mode='human')``
+(reference ``btt/env_rendering.py:6-76``).
+
+The reference preferred gym's pyglet viewer with matplotlib fallback; the
+pyglet path is legacy (removed from modern gym), so blendjax ships the
+matplotlib backend plus the same registry so users can plug their own.
+"""
+
+from __future__ import annotations
+
+#: name -> class; first importable entry wins when backend=None
+RENDER_BACKENDS = {}
+
+
+def register_backend(name, cls):
+    RENDER_BACKENDS[name] = cls
+
+
+def create_renderer(backend=None):
+    """Instantiate a viewer; ``backend=None`` picks the first usable one."""
+    if backend is not None:
+        return RENDER_BACKENDS[backend]()
+    errors = []
+    for name, cls in RENDER_BACKENDS.items():
+        try:
+            return cls()
+        except ImportError as e:  # try the next backend
+            errors.append(f"{name}: {e}")
+    raise ImportError(
+        "No usable render backend; install matplotlib. Tried: " + "; ".join(errors)
+    )
+
+
+class MatplotlibRenderer:
+    """Interactive imshow window updated per frame
+    (reference ``env_rendering.py:29-52``)."""
+
+    def __init__(self):
+        import matplotlib.pyplot as plt
+
+        self._plt = plt
+        plt.ion()
+        self.fig, self.ax = plt.subplots()
+        self.ax.set_axis_off()
+        self.img = None
+
+    def imshow(self, rgb):
+        if self.img is None:
+            self.img = self.ax.imshow(rgb)
+        else:
+            self.img.set_data(rgb)
+        self.fig.canvas.draw_idle()
+        self._plt.pause(0.001)
+
+    def close(self):
+        self._plt.close(self.fig)
+
+
+register_backend("matplotlib", MatplotlibRenderer)

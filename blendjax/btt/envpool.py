@@ -1,0 +1,175 @@
+"""Vectorized remote environments — the env-pool abstraction the reference
+never had (SURVEY.md §7 "hard parts": batching envs across processes for
+vectorized policy training).
+
+``EnvPool`` drives N Blender env instances in lockstep and exposes batched,
+numpy-collated ``reset()``/``step(actions)`` whose outputs feed straight
+into a jitted policy: stack of obs in, vector of actions out.  RPCs are
+pipelined (send to all, then receive from all) so the wall-clock cost per
+pool step is one frame of the slowest instance, not the sum.
+
+``step`` auto-resets finished instances by default: an instance reporting
+``done`` is sent ``reset`` on the *next* step and contributes its fresh
+initial observation (its reward is 0 and done False for that transition) —
+the standard vectorized-env contract (cf. gym vector envs), chosen so
+policy rollouts under ``jax.jit``/``vmap`` see static shapes.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import numpy as np
+import zmq
+
+from blendjax import wire
+from blendjax.btt.collate import collate
+from blendjax.btt.constants import DEFAULT_TIMEOUTMS
+from blendjax.btt.env import kwargs_to_cli
+
+
+class EnvPool:
+    """Batched client for N remote Blender environments.
+
+    Params
+    ------
+    addresses: list[str]
+        GYM endpoints, one per instance (e.g.
+        ``launch_info.addresses['GYM']``).
+    timeoutms: int
+        Per-socket receive timeout.
+    autoreset: bool
+        Auto-reset finished instances during ``step``.
+    """
+
+    def __init__(self, addresses, timeoutms=DEFAULT_TIMEOUTMS, autoreset=True):
+        self._ctx = zmq.Context.instance()
+        self.sockets = []
+        for addr in addresses:
+            s = self._ctx.socket(zmq.REQ)
+            s.setsockopt(zmq.LINGER, 0)
+            s.setsockopt(zmq.SNDTIMEO, timeoutms * 10)
+            s.setsockopt(zmq.RCVTIMEO, timeoutms)
+            s.setsockopt(zmq.REQ_RELAXED, 1)
+            s.setsockopt(zmq.REQ_CORRELATE, 1)
+            s.connect(addr)
+            self.sockets.append(s)
+        self.num_envs = len(addresses)
+        self.env_times = [None] * self.num_envs
+        self._needs_reset = np.ones(self.num_envs, dtype=bool)
+        self.autoreset = autoreset
+
+    # -- pipelined RPC ------------------------------------------------------
+
+    def _exchange(self, requests):
+        """Send one request per env, then collect all replies (pipelined)."""
+        for sock, req in zip(self.sockets, requests):
+            try:
+                wire.send_message(sock, req)
+            except zmq.Again:
+                raise TimeoutError("Failed to send to remote environment") from None
+        replies = []
+        for i, sock in enumerate(self.sockets):
+            try:
+                ddict = wire.recv_message(sock)
+            except zmq.Again:
+                raise TimeoutError(
+                    f"No response from environment {i} within timeout"
+                ) from None
+            self.env_times[i] = ddict.get("time")
+            replies.append(ddict)
+        return replies
+
+    def reset(self):
+        """Reset all instances; returns ``(batched_obs, infos)``."""
+        replies = self._exchange(
+            [{"cmd": "reset", "time": t} for t in self.env_times]
+        )
+        self._needs_reset[:] = False
+        obs = [r.pop("obs") for r in replies]
+        for r in replies:
+            r.pop("rgb_array", None)
+        return collate(obs), replies
+
+    def step(self, actions):
+        """Step all instances with a length-N batch of actions.
+
+        Returns ``(obs, rewards, dones, infos)`` with obs collated and
+        rewards/dones as float32/bool arrays.  With ``autoreset``,
+        instances that reported done on the previous step are reset now.
+        """
+        if len(actions) != self.num_envs:
+            raise ValueError(f"expected {self.num_envs} actions, got {len(actions)}")
+        requests = []
+        for i, action in enumerate(actions):
+            if self.autoreset and self._needs_reset[i]:
+                requests.append({"cmd": "reset", "time": self.env_times[i]})
+            else:
+                requests.append(
+                    {"cmd": "step", "action": action, "time": self.env_times[i]}
+                )
+        replies = self._exchange(requests)
+
+        obs, rewards, dones = [], [], []
+        for i, r in enumerate(replies):
+            was_reset = self.autoreset and self._needs_reset[i]
+            obs.append(r.pop("obs"))
+            rewards.append(0.0 if was_reset else float(r.pop("reward", 0.0)))
+            done = False if was_reset else bool(r.pop("done", False))
+            dones.append(done)
+            self._needs_reset[i] = done
+            r.pop("rgb_array", None)
+        return (
+            collate(obs),
+            np.asarray(rewards, np.float32),
+            np.asarray(dones, bool),
+            replies,
+        )
+
+    def close(self):
+        for s in self.sockets:
+            s.close(0)
+        self.sockets = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+@contextmanager
+def launch_env_pool(
+    scene,
+    script,
+    num_instances,
+    background=False,
+    timeoutms=DEFAULT_TIMEOUTMS,
+    autoreset=True,
+    **kwargs,
+):
+    """Launch N Blender env instances and yield a connected EnvPool.
+
+    The pool analog of :func:`blendjax.btt.env.launch_env`; extra kwargs
+    become CLI flags for every instance's env script.
+    """
+    from blendjax.btt.launcher import BlenderLauncher
+
+    with BlenderLauncher(
+        scene=scene,
+        script=script,
+        num_instances=num_instances,
+        named_sockets=["GYM"],
+        instance_args=[list(kwargs_to_cli(kwargs)) for _ in range(num_instances)],
+        background=background,
+    ) as bl:
+        pool = EnvPool(
+            bl.launch_info.addresses["GYM"],
+            timeoutms=timeoutms,
+            autoreset=autoreset,
+        )
+        try:
+            yield pool
+        finally:
+            pool.close()

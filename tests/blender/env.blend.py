@@ -1,0 +1,53 @@
+"""Env fixture for the fake-Blender fleet: a deterministic environment whose
+obs equals the applied action and whose reward is action/10, enabling exact
+asserts (mirrors the reference fixture pattern,
+``tests/blender/env.blend.py:7-29``).  Runs the REAL BaseEnv +
+RemoteControlledAgent + AnimationController stack over fake bpy."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from helpers import fake_bpy  # noqa: E402
+
+fake_bpy.install()
+
+from blendjax.btb.arguments import parse_blendtorch_args  # noqa: E402
+from blendjax.btb.env import BaseEnv, RemoteControlledAgent  # noqa: E402
+
+
+class EchoEnv(BaseEnv):
+    """obs == last applied action; reward == action / 10; episode horizon
+    set by the frame range."""
+
+    def __init__(self, agent):
+        super().__init__(agent)
+        self.applied = 0.0
+
+    def _env_reset(self):
+        self.applied = 0.0
+
+    def _env_prepare_step(self, action):
+        self.applied = float(action)
+
+    def _env_post_step(self):
+        return {
+            "obs": self.applied,
+            "reward": self.applied / 10.0,
+            "frame": self.events.frameid,
+        }
+
+
+def main():
+    btargs, remainder = parse_blendtorch_args()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--horizon", type=int, default=10)
+    args = parser.parse_args(remainder)
+
+    agent = RemoteControlledAgent(btargs.btsockets["GYM"], timeoutms=30000)
+    env = EchoEnv(agent)
+    env.run(frame_range=(1, args.horizon), use_animation=False)
+
+
+main()
