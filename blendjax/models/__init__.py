@@ -1,0 +1,23 @@
+"""TPU-first model zoo for the blendjax workloads.
+
+- ``detector``      — keypoint CNN for the datagen workload (flagship).
+- ``discriminator`` — real/fake image scorer for densityopt.
+- ``probmodel``     — log-normal sim-parameter model + score-function grads.
+- ``policy``        — MLP policies + REINFORCE for the control workload.
+- ``train``         — TrainState + jitted/donated train-step builders.
+"""
+
+from blendjax.models import detector, discriminator, layers, policy, probmodel, train
+from blendjax.models.train import TrainState, make_eval_step, make_train_step
+
+__all__ = [
+    "detector",
+    "discriminator",
+    "layers",
+    "policy",
+    "probmodel",
+    "train",
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+]

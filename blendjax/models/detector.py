@@ -1,0 +1,62 @@
+"""TinyDetector — the flagship supervised model for the datagen workload.
+
+The consumer-side counterpart of ``examples/datagen`` in the reference
+(``generate.py`` streams ``image, xy`` pairs; a downstream model regresses
+the cube's vertex pixels).  The reference leaves the model to user land;
+blendjax ships one, TPU-first: NHWC bfloat16 convs (MXU), static shapes,
+global-average-pool head regressing K keypoints in normalized [0,1] image
+coordinates.
+
+Pytree layout (for sharding): convs are replicated (small), the two dense
+layers carry the parameter mass and shard tensor-parallel over the
+``'model'`` mesh axis (see ``blendjax.parallel.sharding.detector_rules``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blendjax.models.layers import conv_apply, conv_init, dense_apply, dense_init, gelu
+
+
+def init(key, num_keypoints=8, channels=(32, 64, 128), in_channels=3, hidden=256):
+    """Initialize detector params for ``num_keypoints`` (x, y) outputs."""
+    keys = jax.random.split(key, len(channels) + 2)
+    params = {"convs": []}
+    c_in = in_channels
+    for i, c_out in enumerate(channels):
+        params["convs"].append(conv_init(keys[i], c_in, c_out, ksize=3))
+        c_in = c_out
+    params["fc"] = dense_init(keys[-2], c_in, hidden)
+    params["head"] = dense_init(keys[-1], hidden, num_keypoints * 2)
+    return params
+
+
+def apply(params, images, compute_dtype=jnp.bfloat16):
+    """Forward pass.
+
+    Params
+    ------
+    images: (N, H, W, C) float in [0, 1].
+    Returns (N, K, 2) predicted keypoints in [0, 1] normalized coordinates.
+    """
+    x = images.astype(compute_dtype)
+    for conv in params["convs"]:
+        x = gelu(conv_apply(conv, x, stride=2, dtype=compute_dtype))
+    x = x.mean(axis=(1, 2))  # global average pool
+    x = gelu(dense_apply(params["fc"], x, dtype=compute_dtype))
+    out = dense_apply(params["head"], x, dtype=compute_dtype)
+    k2 = out.shape[-1]
+    out = jax.nn.sigmoid(out.astype(jnp.float32))
+    return out.reshape(*out.shape[:-1], k2 // 2, 2)
+
+
+def loss_fn(params, batch, compute_dtype=jnp.bfloat16):
+    """MSE over normalized keypoints.
+
+    ``batch`` = {'image': (N,H,W,C) float [0,1], 'xy': (N,K,2) normalized}.
+    """
+    pred = apply(params, batch["image"], compute_dtype)
+    err = pred - batch["xy"].astype(jnp.float32)
+    return jnp.mean(err * err)

@@ -1,0 +1,59 @@
+"""Patch discriminator for the densityopt workload.
+
+Counterpart of the reference's DCGAN-style torch Discriminator
+(``examples/densityopt/densityopt.py:139-190``) that scores rendered
+supershape images as real/fake; its loss on simulated images is the signal
+the score-function estimator pushes back into Blender's scene parameters.
+TPU-first: strided NHWC bfloat16 convs, no batchnorm (leaky-ReLU +
+layer-scale keeps it SPMD-trivial: no cross-device batch statistics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blendjax.models.layers import conv_apply, conv_init, dense_apply, dense_init
+
+
+def init(key, in_channels=1, widths=(32, 64, 128)):
+    keys = jax.random.split(key, len(widths) + 1)
+    params = {"convs": []}
+    c_in = in_channels
+    for i, c_out in enumerate(widths):
+        params["convs"].append(conv_init(keys[i], c_in, c_out, ksize=4))
+        c_in = c_out
+    params["head"] = dense_init(keys[-1], c_in, 1)
+    return params
+
+
+def apply(params, images, compute_dtype=jnp.bfloat16):
+    """(N, H, W, C) float -> (N,) real/fake logits."""
+    x = images.astype(compute_dtype)
+    for conv in params["convs"]:
+        x = jax.nn.leaky_relu(conv_apply(conv, x, stride=2, dtype=compute_dtype), 0.2)
+    x = x.mean(axis=(1, 2))
+    return dense_apply(params["head"], x, dtype=compute_dtype).astype(jnp.float32)[..., 0]
+
+
+def bce_logits(logits, targets):
+    """Numerically-stable binary cross entropy on logits."""
+    return jnp.mean(
+        jnp.maximum(logits, 0.0) - logits * targets + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def d_loss_fn(params, real_images, fake_images, compute_dtype=jnp.bfloat16):
+    """Discriminator loss: real -> 1, simulated -> 0."""
+    logits_real = apply(params, real_images, compute_dtype)
+    logits_fake = apply(params, fake_images, compute_dtype)
+    return bce_logits(logits_real, jnp.ones_like(logits_real)) + bce_logits(
+        logits_fake, jnp.zeros_like(logits_fake)
+    )
+
+
+def sim_scores(params, fake_images, compute_dtype=jnp.bfloat16):
+    """Per-sample 'fool the discriminator' losses for the score-function
+    estimator: -log D(fake)."""
+    logits = apply(params, fake_images, compute_dtype)
+    return jnp.maximum(logits, 0.0) - logits + jnp.log1p(jnp.exp(-jnp.abs(logits)))

@@ -1,0 +1,47 @@
+"""Minimal functional NN layers (pure jax pytrees).
+
+blendjax models are plain ``{name: array}`` pytrees with ``init``/``apply``
+functions — no module framework — so they jit, shard (NamedSharding over
+pytree leaves), and donate cleanly.  Convs are NHWC/HWIO, the TPU-native
+layout; compute dtype is a parameter so models run bfloat16 on the MXU with
+float32 params.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv_init(key, in_ch, out_ch, ksize=3):
+    """He-normal conv kernel (HWIO) + zero bias."""
+    fan_in = ksize * ksize * in_ch
+    w = jax.random.normal(key, (ksize, ksize, in_ch, out_ch)) * jnp.sqrt(2.0 / fan_in)
+    return {"w": w, "b": jnp.zeros((out_ch,))}
+
+
+def conv_apply(p, x, stride=1, padding="SAME", dtype=None):
+    dtype = dtype or x.dtype
+    out = lax.conv_general_dilated(
+        x.astype(dtype),
+        p["w"].astype(dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + p["b"].astype(dtype)
+
+
+def dense_init(key, d_in, d_out):
+    w = jax.random.normal(key, (d_in, d_out)) * jnp.sqrt(2.0 / d_in)
+    return {"w": w, "b": jnp.zeros((d_out,))}
+
+
+def dense_apply(p, x, dtype=None):
+    dtype = dtype or x.dtype
+    return x.astype(dtype) @ p["w"].astype(dtype) + p["b"].astype(dtype)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)

@@ -1,0 +1,96 @@
+"""Policies + REINFORCE losses for the control (cartpole) workload.
+
+The reference's control example uses a hand-written P-controller
+(``examples/control/cartpole.py:19-35``) and leaves learning to the user;
+blendjax ships a small learnable stack: an MLP policy (categorical over
+discrete actions or Gaussian over continuous ones) with a jitted REINFORCE
+update, designed to train against a batched :class:`blendjax.btt.envpool.EnvPool`
+under a data-parallel mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blendjax.models.layers import dense_apply, dense_init
+
+
+def init(key, obs_dim, num_actions, hidden=(64, 64), continuous=False):
+    """MLP policy params.  ``continuous=True`` adds a state-independent
+    log-std head for a Gaussian policy."""
+    dims = (obs_dim, *hidden)
+    keys = jax.random.split(key, len(dims))
+    params = {
+        "layers": [
+            dense_init(keys[i], dims[i], dims[i + 1]) for i in range(len(dims) - 1)
+        ],
+        "out": dense_init(keys[-1], dims[-1], num_actions),
+    }
+    if continuous:
+        params["log_std"] = jnp.zeros((num_actions,))
+    return params
+
+
+def logits(params, obs):
+    x = jnp.asarray(obs, jnp.float32)
+    for layer in params["layers"]:
+        x = jnp.tanh(dense_apply(layer, x))
+    return dense_apply(params["out"], x)
+
+
+def sample_action(params, key, obs):
+    """Sample actions (and their log-probs) for a batch of observations."""
+    out = logits(params, obs)
+    if "log_std" in params:
+        std = jnp.exp(params["log_std"])
+        eps = jax.random.normal(key, out.shape)
+        action = out + std * eps
+        logp = gaussian_log_prob(params, obs, action)
+        return action, logp
+    action = jax.random.categorical(key, out, axis=-1)
+    logp = jax.nn.log_softmax(out)[jnp.arange(out.shape[0]), action]
+    return action, logp
+
+
+def categorical_log_prob(params, obs, actions):
+    lp = jax.nn.log_softmax(logits(params, obs))
+    return jnp.take_along_axis(lp, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def gaussian_log_prob(params, obs, actions):
+    mean = logits(params, obs)
+    std = jnp.exp(params["log_std"])
+    z = (actions - mean) / std
+    return (-0.5 * z * z - params["log_std"] - 0.5 * jnp.log(2 * jnp.pi)).sum(-1)
+
+
+def discounted_returns(rewards, dones, gamma=0.99):
+    """Per-step discounted returns over a (T, N) rollout, resetting at
+    episode boundaries.  ``lax.scan`` keeps it jittable for any T."""
+
+    def step(carry, inp):
+        r, d = inp
+        carry = r + gamma * carry * (1.0 - d)
+        return carry, carry
+
+    _, rev = jax.lax.scan(
+        step,
+        jnp.zeros(rewards.shape[1]),
+        (rewards[::-1], dones[::-1].astype(jnp.float32)),
+    )
+    return rev[::-1]
+
+
+def reinforce_loss(params, obs, actions, returns, continuous=False):
+    """-E[log pi(a|s) * (G - baseline)] with a batch-mean baseline.
+
+    ``obs`` (T*N, obs_dim), ``actions`` (T*N,), ``returns`` (T*N,).
+    """
+    if continuous:
+        logp = gaussian_log_prob(params, obs, actions)
+    else:
+        logp = categorical_log_prob(params, obs, actions)
+    advantage = returns - returns.mean()
+    advantage = advantage / (returns.std() + 1e-6)
+    return -jnp.mean(logp * jax.lax.stop_gradient(advantage))

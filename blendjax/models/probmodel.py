@@ -1,0 +1,69 @@
+"""Log-normal probability model over simulation parameters + score-function
+(REINFORCE) gradients — the densityopt simulator-side model.
+
+Counterpart of the reference's torch ``ProbModel``
+(``examples/densityopt/densityopt.py:30-93``): a distribution over
+supershape parameters (m1, m2) whose samples are pushed through a
+**non-differentiable renderer** (Blender).  Gradients flow via the
+likelihood-ratio trick with an EMA baseline
+(``densityopt.py:278-309``), never through the renderer:
+
+    grad = E[ grad log p(sample) * (loss(sample) - baseline) ]
+
+All estimator math is jittable; only the render round-trip (duplex send /
+stream recv) stays host-side.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init(mu, sigma):
+    """Params for independent log-normals: ``log X ~ N(mu, sigma)``.
+
+    ``mu``/``sigma`` are length-K arrays (K simulation parameters).
+    ``sigma`` is stored in log space for unconstrained optimization.
+    """
+    mu = jnp.asarray(mu, jnp.float32)
+    return {"mu": mu, "log_sigma": jnp.log(jnp.asarray(sigma, jnp.float32))}
+
+
+def sample(params, key, n):
+    """(n, K) positive samples, reparameterized draw (but treated as
+    non-differentiable by the estimator — matches the score-function
+    setting where the renderer breaks the chain anyway)."""
+    eps = jax.random.normal(key, (n, params["mu"].shape[-1]))
+    return jnp.exp(params["mu"] + jnp.exp(params["log_sigma"]) * eps)
+
+
+def log_prob(params, x):
+    """Elementwise-summed log density of the log-normal at ``x`` (n, K)."""
+    mu, sigma = params["mu"], jnp.exp(params["log_sigma"])
+    z = (jnp.log(x) - mu) / sigma
+    log_pdf = -0.5 * z * z - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi) - jnp.log(x)
+    return log_pdf.sum(-1)
+
+
+def score_loss(params, samples, losses, baseline):
+    """Surrogate whose gradient is the score-function estimator.
+
+    ``samples`` (n, K) came from ``sample``; ``losses`` (n,) were measured
+    through the non-differentiable pipeline; ``baseline`` is a variance-
+    reduction scalar (e.g. EMA of recent losses).
+    """
+    advantage = jax.lax.stop_gradient(losses - baseline)
+    return jnp.mean(log_prob(params, jax.lax.stop_gradient(samples)) * advantage)
+
+
+def ema_update(baseline, losses, decay=0.9):
+    """EMA baseline update (reference keeps a running mean,
+    ``densityopt.py:290-309``)."""
+    return decay * baseline + (1.0 - decay) * losses.mean()
+
+
+def mean(params):
+    """Distribution mean of the log-normal: exp(mu + sigma^2/2)."""
+    sigma = jnp.exp(params["log_sigma"])
+    return jnp.exp(params["mu"] + 0.5 * sigma * sigma)

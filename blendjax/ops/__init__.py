@@ -1,0 +1,20 @@
+"""Device-side input-pipeline ops (decode, color, augmentation)."""
+
+from blendjax.ops import augment, image
+from blendjax.ops.image import (
+    decode_frames,
+    decode_frames_pallas,
+    linear_to_srgb,
+    normalize,
+    srgb_to_linear,
+)
+
+__all__ = [
+    "augment",
+    "image",
+    "decode_frames",
+    "decode_frames_pallas",
+    "linear_to_srgb",
+    "normalize",
+    "srgb_to_linear",
+]

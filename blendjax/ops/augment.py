@@ -1,0 +1,61 @@
+"""Device-side data augmentation, jit/vmap-friendly.
+
+Domain randomization happens producer-side in Blender (pose/material/light
+randomization in the ``*.blend.py`` scripts); these ops add cheap
+consumer-side augmentation on the TPU, keyed by explicit PRNG keys so the
+whole input pipeline stays functional and reproducible.  All shapes are
+static (crops use ``lax.dynamic_slice`` with static sizes) so everything
+compiles once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_hflip(key, images, keypoints_xy=None):
+    """Flip a NHWC batch horizontally with per-sample probability 0.5.
+
+    When pixel-space ``keypoints_xy`` (N, K, 2) are given they are flipped
+    consistently and returned alongside.
+    """
+    n = images.shape[0]
+    w = images.shape[2]
+    flip = jax.random.bernoulli(key, 0.5, (n,))
+    flipped = jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+    if keypoints_xy is None:
+        return flipped
+    kx = jnp.where(flip[:, None], w - 1 - keypoints_xy[..., 0], keypoints_xy[..., 0])
+    kps = jnp.stack([kx, keypoints_xy[..., 1]], axis=-1)
+    return flipped, kps
+
+
+def random_crop(key, images, crop_hw):
+    """Random spatial crop of a NHWC batch to static (ch, cw)."""
+    n, h, w, c = images.shape
+    ch, cw = crop_hw
+    ky, kx = jax.random.split(key)
+    tops = jax.random.randint(ky, (n,), 0, h - ch + 1)
+    lefts = jax.random.randint(kx, (n,), 0, w - cw + 1)
+
+    def crop_one(img, top, left):
+        return lax.dynamic_slice(img, (top, left, 0), (ch, cw, c))
+
+    return jax.vmap(crop_one)(images, tops, lefts)
+
+
+def random_brightness(key, images, max_delta=0.2):
+    """Additive brightness jitter on [0,1] float images."""
+    n = images.shape[0]
+    delta = jax.random.uniform(key, (n, 1, 1, 1), minval=-max_delta, maxval=max_delta)
+    return jnp.clip(images + delta, 0.0, 1.0)
+
+
+def random_contrast(key, images, lower=0.8, upper=1.2):
+    """Multiplicative contrast jitter around the per-image mean."""
+    n = images.shape[0]
+    factor = jax.random.uniform(key, (n, 1, 1, 1), minval=lower, maxval=upper)
+    mean = images.mean(axis=(1, 2, 3), keepdims=True)
+    return jnp.clip((images - mean) * factor + mean, 0.0, 1.0)

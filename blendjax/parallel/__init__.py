@@ -1,0 +1,20 @@
+"""Mesh construction, sharding rules, and SPMD train-step builders."""
+
+from blendjax.parallel.mesh import data_mesh, data_sharding, make_mesh, replicated
+from blendjax.parallel.sharding import (
+    detector_rules,
+    make_sharded_train_step,
+    param_specs,
+    shard_pytree,
+)
+
+__all__ = [
+    "data_mesh",
+    "data_sharding",
+    "make_mesh",
+    "replicated",
+    "detector_rules",
+    "make_sharded_train_step",
+    "param_specs",
+    "shard_pytree",
+]

@@ -1,0 +1,98 @@
+"""Sharding rules and the mesh-sharded train step.
+
+How blendjax scales model-side (SURVEY.md §2.4: the reference has *no*
+model parallelism — consumer scale-out there is DataLoader workers only):
+
+- **data axis**: the stream feeds per-host batch shards
+  (``BatchLoader(shard=(process_index, process_count))``), the batch is
+  sharded ``P('data')``, and XLA turns the gradient sum into a psum over
+  ICI.
+- **model axis**: wide dense layers shard their output features
+  ``P(None, 'model')``; XLA inserts the all-gather/reduce-scatter pairs.
+
+Rules map pytree paths to PartitionSpecs; anything unmatched replicates.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from blendjax.models.train import TrainState
+
+
+def detector_rules(axis="model"):
+    """Tensor-parallel rules for :mod:`blendjax.models.detector`: the two
+    dense layers carry the parameter mass and split their features; convs
+    replicate (tiny, bandwidth-bound)."""
+    return {
+        ("fc", "w"): P(None, axis),
+        ("fc", "b"): P(axis),
+        ("head", "w"): P(axis, None),  # row-parallel: consumes fc's sharded out
+        ("head", "b"): P(),
+    }
+
+
+def _path_key(path):
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(p.key)
+        elif hasattr(p, "idx"):
+            out.append(p.idx)
+        elif hasattr(p, "name"):
+            out.append(p.name)
+    return tuple(out)
+
+
+def param_specs(params, rules):
+    """PartitionSpec pytree for ``params``: longest-suffix match of each
+    leaf path against ``rules`` keys; default replicate."""
+
+    def spec_for(path):
+        key = _path_key(path)
+        for rule_key, spec in rules.items():
+            if key[-len(rule_key):] == tuple(rule_key):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(lambda path, _: spec_for(path), params)
+
+
+def shard_pytree(tree, mesh, specs):
+    """Place a pytree on the mesh according to a spec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def make_sharded_train_step(loss_fn, optimizer, mesh, rules=None, data_axis="data"):
+    """Build ``(init_sharded, step)`` for SPMD training over ``mesh``.
+
+    ``init_sharded(params)`` places params (and fresh optimizer state)
+    according to ``rules``; ``step(state, batch)`` is jitted with sharded
+    in/out so XLA lays gradients' psum over the data axis and the tensor-
+    parallel collectives over the model axis automatically.  The batch must
+    arrive sharded ``P(data_axis)`` (use
+    ``JaxStream(sharding=data_sharding(mesh))``).
+    """
+    rules = rules or {}
+
+    def init_sharded(params):
+        specs = param_specs(params, rules)
+        params = shard_pytree(params, mesh, specs)
+        opt_state = optimizer.init(params)  # inherits param shardings
+        return TrainState(params=params, opt_state=opt_state, step=0)
+
+    def _step(state, batch):
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_sharded, jax.jit(_step, donate_argnums=(0,))

@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (stage timing / duty-cycle observability)."""
+
+from blendjax.utils.timing import StageTimer
+
+__all__ = ["StageTimer"]

@@ -1,0 +1,74 @@
+"""Image-op tests: sRGB round trips, fused decode, Pallas kernel parity
+(interpret mode on the CPU mesh), and augmentation invariants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blendjax.ops import augment, image
+
+
+def test_srgb_roundtrip():
+    x = jnp.linspace(0.0, 1.0, 64)
+    rt = image.linear_to_srgb(image.srgb_to_linear(x))
+    np.testing.assert_allclose(np.asarray(rt), np.asarray(x), atol=1e-6)
+
+
+def test_decode_frames_values():
+    u8 = jnp.array([[0, 128, 255]], dtype=jnp.uint8)
+    out = image.decode_frames(u8)
+    np.testing.assert_allclose(
+        np.asarray(out), [[0.0, 128 / 255, 1.0]], atol=1e-7
+    )
+    out_n = image.decode_frames(u8, mean=0.5, std=0.5)
+    np.testing.assert_allclose(np.asarray(out_n), [[-1.0, (128 / 255 - 0.5) / 0.5, 1.0]], atol=1e-6)
+    assert image.decode_frames(u8, dtype=jnp.bfloat16).dtype == jnp.bfloat16
+
+
+def test_pallas_decode_matches_reference():
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, size=(2, 13, 17, 3), dtype=np.uint8)  # odd sizes
+    ref = image.decode_frames(jnp.asarray(frames))
+    out = image.decode_frames_pallas(jnp.asarray(frames), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-7)
+    assert out.shape == frames.shape
+
+
+def test_pallas_decode_linearize():
+    frames = jnp.arange(256, dtype=jnp.uint8).reshape(1, 16, 16, 1)
+    ref = image.decode_frames(frames, linearize=True)
+    out = image.decode_frames_pallas(frames, linearize=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_random_hflip_consistency():
+    key = jax.random.PRNGKey(0)
+    imgs = jnp.arange(2 * 4 * 6 * 1, dtype=jnp.float32).reshape(2, 4, 6, 1)
+    kps = jnp.array([[[0.0, 1.0], [5.0, 2.0]], [[2.0, 0.0], [3.0, 3.0]]])
+    flipped, kflip = augment.random_hflip(key, imgs, kps)
+    flip_mask = jax.random.bernoulli(key, 0.5, (2,))
+    for i in range(2):
+        if bool(flip_mask[i]):
+            np.testing.assert_allclose(flipped[i], imgs[i, :, ::-1, :])
+            np.testing.assert_allclose(kflip[i, :, 0], 6 - 1 - kps[i, :, 0])
+        else:
+            np.testing.assert_allclose(flipped[i], imgs[i])
+            np.testing.assert_allclose(kflip[i], kps[i])
+
+
+def test_random_crop_shape_and_content():
+    key = jax.random.PRNGKey(1)
+    imgs = jnp.stack([jnp.full((8, 8, 2), i, jnp.float32) for i in range(3)])
+    out = augment.random_crop(key, imgs, (4, 4))
+    assert out.shape == (3, 4, 4, 2)
+    for i in range(3):  # crops come from the right sample
+        np.testing.assert_allclose(out[i], i)
+
+
+def test_brightness_contrast_bounds():
+    key = jax.random.PRNGKey(2)
+    imgs = jnp.full((4, 8, 8, 3), 0.5, jnp.float32)
+    b = augment.random_brightness(key, imgs, 0.3)
+    assert float(b.min()) >= 0.0 and float(b.max()) <= 1.0
+    c = augment.random_contrast(key, imgs)
+    np.testing.assert_allclose(np.asarray(c), 0.5, atol=1e-6)  # flat image invariant

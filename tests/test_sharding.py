@@ -1,0 +1,91 @@
+"""SPMD tests on the 8-device virtual CPU mesh: sharding-rule resolution,
+tensor-parallel placement of the detector's dense layers, and a full
+dp x tp sharded train step (batch P('data'), params per rules)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from blendjax.models import detector
+from blendjax.parallel import (
+    data_sharding,
+    detector_rules,
+    make_mesh,
+    make_sharded_train_step,
+    param_specs,
+    shard_pytree,
+)
+
+
+def test_param_specs_rule_matching():
+    params = detector.init(jax.random.PRNGKey(0), num_keypoints=2)
+    specs = param_specs(params, detector_rules())
+    assert specs["fc"]["w"] == P(None, "model")
+    assert specs["fc"]["b"] == P("model")
+    assert specs["head"]["w"] == P("model", None)
+    assert specs["convs"][0]["w"] == P()  # unmatched -> replicated
+
+
+def test_shard_pytree_placement():
+    mesh = make_mesh({"data": 4, "model": 2})
+    params = detector.init(jax.random.PRNGKey(0), num_keypoints=2, hidden=64)
+    specs = param_specs(params, detector_rules())
+    sharded = shard_pytree(params, mesh, specs)
+    fc_w = sharded["fc"]["w"]
+    assert fc_w.sharding == NamedSharding(mesh, P(None, "model"))
+    # each model-shard holds half the features
+    shapes = {s.data.shape for s in fc_w.addressable_shards}
+    assert shapes == {(fc_w.shape[0], fc_w.shape[1] // 2)}
+
+
+def test_sharded_train_step_dp_tp():
+    mesh = make_mesh({"data": 4, "model": 2})
+    opt = optax.adam(1e-3)
+    init_sharded, step = make_sharded_train_step(
+        detector.loss_fn, opt, mesh, rules=detector_rules()
+    )
+    params = detector.init(jax.random.PRNGKey(0), num_keypoints=2, channels=(8,), hidden=32)
+    state = init_sharded(params)
+
+    batch = {
+        "image": jax.device_put(
+            np.random.default_rng(0).random((16, 16, 16, 3), np.float32),
+            data_sharding(mesh),
+        ),
+        "xy": jax.device_put(
+            np.full((16, 2, 2), 0.5, np.float32), data_sharding(mesh)
+        ),
+    }
+    state, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+    # params keep their TP sharding through the update
+    assert state.params["fc"]["w"].sharding.spec == P(None, "model")
+    # a second step works on the donated state
+    state2, loss2 = step(state, batch)
+    assert np.isfinite(float(loss2))
+    assert int(state2.step) == 2
+
+
+def test_dp_equivalence_with_single_device():
+    """The sharded step computes the same loss as an unsharded one."""
+    mesh = make_mesh({"data": 8})
+    opt = optax.sgd(0.1)
+    init_sharded, step = make_sharded_train_step(detector.loss_fn, opt, mesh, rules={})
+    params = detector.init(jax.random.PRNGKey(1), num_keypoints=1, channels=(4,), hidden=8)
+    state = init_sharded(jax.tree.map(jnp.copy, params))
+
+    rng = np.random.default_rng(1)
+    batch_np = {
+        "image": rng.random((8, 8, 8, 3), np.float32),
+        "xy": rng.random((8, 1, 2), np.float32),
+    }
+    batch = jax.tree.map(
+        lambda x: jax.device_put(x, data_sharding(mesh)), batch_np
+    )
+    _, loss_sharded = step(state, batch)
+
+    loss_ref = detector.loss_fn(params, jax.tree.map(jnp.asarray, batch_np))
+    # bf16 compute: reductions associativity differs across shardings
+    np.testing.assert_allclose(float(loss_sharded), float(loss_ref), rtol=1e-3)
