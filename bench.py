@@ -1,0 +1,50 @@
+"""Driver benchmark: one JSON line on stdout.
+
+Measures the blendjax end-to-end streaming pipeline on the reference's own
+headline configuration (``Readme.md:92``: Cube scene 640x480 RGBA, 4
+producer instances, 4 workers, batch 8 — 0.012 sec/image there): synthetic
+producers speaking the real wire protocol -> fan-in PULL -> threaded batch
+loader -> double-buffered device_put into TPU HBM -> detector train step
+per batch.  Rendering itself is excluded on both sides of the comparison's
+consumer path (the reference number includes Blender's render; ours uses
+synthetic frames because Blender cannot run in this image), so treat
+``vs_baseline`` as transport+train throughput vs the reference's full
+pipeline ceiling.
+
+``vs_baseline`` = measured images/sec over the reference's 4-instance
+83.3 images/sec (1 / 0.012).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+#: reference Readme.md:92 — 4 instances, 0.012 sec/image
+BASELINE_IMAGES_PER_SEC = 1.0 / 0.012
+
+
+def main():
+    sys.path.insert(0, ".")
+    from benchmarks.benchmark import parse_args, run
+
+    args = parse_args(
+        ["--instances", "4", "--workers", "4", "--batch", "8", "--items", "512"]
+    )
+    result = run(args)
+    print(
+        json.dumps(
+            {
+                "metric": "cube640x480_images_per_sec_stream_to_train",
+                "value": round(result["images_per_sec"], 2),
+                "unit": "images/sec",
+                "vs_baseline": round(
+                    result["images_per_sec"] / BASELINE_IMAGES_PER_SEC, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""End-to-end streaming benchmark (port of the reference harness,
+``benchmarks/benchmark.py``: BATCH=8, 4 producer instances, 4 workers, 512
+items, Cube-scene 640x480 RGBA; first batch discarded as warmup, prints
+sec/image and sec/batch).
+
+Differences, on purpose:
+- producers are synthetic (real Blender doesn't run on a TPU-VM CI image);
+  they speak the identical wire protocol through the real DataPublisher, so
+  everything downstream of rendering — serialize, send, fan-in recv,
+  decode, collate, device_put, train — is measured for real.
+- the pipeline continues to the TPU: batches land in HBM via the
+  double-buffered prefetcher and a detector train step runs per batch
+  (pass --no-train for the stream-only configuration of BASELINE.md).
+- per-stage timing (recv/collate/device_put) and feed duty cycle printed.
+
+Run: python benchmarks/benchmark.py [--raw] [--instances 4] [--items 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PRODUCER = os.path.join(HERE, "stream_producer.py")
+
+
+def free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_producers(n, raw, width, height):
+    addrs, procs = [], []
+    for i in range(n):
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        cmd = [
+            sys.executable,
+            PRODUCER,
+            "--addr", addr,
+            "--btid", str(i),
+            "--width", str(width),
+            "--height", str(height),
+        ]
+        if raw:
+            cmd.append("--raw")
+        procs.append(subprocess.Popen(cmd))
+        addrs.append(addr)
+    return addrs, procs
+
+
+def run(args):
+    import jax
+
+    from blendjax.btt.dataset import RemoteIterableDataset
+    from blendjax.btt.prefetch import JaxStream
+    from blendjax.ops.image import decode_frames
+
+    addrs, procs = launch_producers(args.instances, args.raw, args.width, args.height)
+    try:
+        ds = RemoteIterableDataset(
+            addrs, max_items=args.items, timeoutms=60000, queue_size=args.queue
+        )
+
+        train_step = None
+        state = None
+        if args.train:
+            import optax
+
+            from blendjax.models import detector
+            from blendjax.models.train import TrainState, make_train_step
+
+            params = detector.init(
+                jax.random.PRNGKey(0), num_keypoints=8, in_channels=args.channels
+            )
+            opt = optax.adam(1e-3)
+            state = TrainState.create(params, opt)
+            base_loss = detector.loss_fn
+
+            def loss_with_decode(params, batch):
+                images = decode_frames(batch["image"], dtype=jax.numpy.bfloat16)
+                return base_loss(params, {"image": images, "xy": batch["xy"]})
+
+            train_step = make_train_step(loss_with_decode, opt)
+
+        def transform(batch):
+            # normalize keypoints to [0,1] on host (tiny); images ship uint8
+            return {
+                "image": batch["image"],
+                "xy": batch["xy"].astype(np.float32),
+            }
+
+        stream = JaxStream(
+            ds,
+            batch_size=args.batch,
+            num_workers=args.workers,
+            transform=transform,
+            prefetch=2,
+        )
+
+        n_batches = 0
+        t0 = None
+        step_time = 0.0
+        for batch in stream:
+            if train_step is not None:
+                ts = time.perf_counter()
+                state, loss = train_step(state, batch)
+                jax.block_until_ready(loss)
+                step_time += time.perf_counter() - ts
+            else:
+                jax.block_until_ready(batch["image"])
+            n_batches += 1
+            if n_batches == args.warmup_batches:
+                t0 = time.perf_counter()  # discard warmup incl. compile
+                step_time = 0.0
+        elapsed = time.perf_counter() - t0
+        measured = n_batches - args.warmup_batches
+        images = measured * args.batch
+
+        sec_img = elapsed / images
+        stats = stream.timer.summary()
+        return {
+            "images_per_sec": images / elapsed,
+            "sec_per_image": sec_img,
+            "sec_per_batch": elapsed / measured,
+            "train_duty_cycle": (step_time / elapsed) if train_step else None,
+            "stages": stats,
+            "batches": measured,
+        }
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--instances", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--items", type=int, default=512)
+    ap.add_argument("--queue", type=int, default=10)
+    ap.add_argument("--width", type=int, default=640)
+    ap.add_argument("--height", type=int, default=480)
+    ap.add_argument("--channels", type=int, default=4)
+    ap.add_argument("--warmup-batches", type=int, default=8)
+    ap.add_argument("--raw", action="store_true", default=True,
+                    help="zero-copy wire encoding (blendjax native)")
+    ap.add_argument("--pickle", dest="raw", action="store_false",
+                    help="reference-compatible pickle encoding")
+    ap.add_argument("--no-train", dest="train", action="store_false",
+                    help="stream-only (BASELINE.md configuration)")
+    return ap.parse_args(argv)
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    result = run(args)
+    print(f"images/sec      : {result['images_per_sec']:.1f}")
+    print(f"sec/image       : {result['sec_per_image']:.5f}")
+    print(f"sec/batch({args.batch})    : {result['sec_per_batch']:.5f}")
+    if result["train_duty_cycle"] is not None:
+        print(f"train duty cycle: {result['train_duty_cycle']:.1%}")
+    for name, s in result["stages"].items():
+        print(f"stage {name:11s}: {s['mean_ms']:.2f} ms avg x {s['count']}")

@@ -1,0 +1,98 @@
+"""Producer script: cartpole on Blender rigid-body physics (counterpart of
+reference ``examples/control/cartpole_gym/envs/cartpole.blend.py`` — same
+env contract: action = motor force, obs = (cart_x, pole_x, pole_angle),
+done on |angle| > 0.6 or |cart_x| > 4).
+
+The cart/pole rig is built procedurally: a kinematic cart cube driven by
+velocity integration, a dynamic pole attached with a hinge constraint.
+"""
+
+import argparse
+
+import bpy
+
+from blendjax import btb
+
+
+def build_scene():
+    for o in list(bpy.data.objects):
+        bpy.data.objects.remove(o, do_unlink=True)
+
+    bpy.ops.mesh.primitive_cube_add(size=1.0, location=(0, 0, 0.5))
+    cart = bpy.context.active_object
+    cart.name = "Cart"
+    bpy.ops.rigidbody.object_add({"object": cart})
+    cart.rigid_body.kinematic = True
+
+    bpy.ops.mesh.primitive_cube_add(size=0.2, location=(0, 0, 2.0))
+    pole = bpy.context.active_object
+    pole.name = "Pole"
+    pole.scale = (0.1, 0.1, 1.0)
+    bpy.ops.rigidbody.object_add({"object": pole})
+
+    bpy.ops.object.empty_add(location=(0, 0, 1.0))
+    pivot = bpy.context.active_object
+    bpy.ops.rigidbody.constraint_add({"object": pivot})
+    pivot.rigid_body_constraint.type = "HINGE"
+    pivot.rigid_body_constraint.object1 = cart
+    pivot.rigid_body_constraint.object2 = pole
+
+    bpy.ops.object.camera_add(location=(0, -12, 2))
+    bpy.context.scene.camera = bpy.context.active_object
+    bpy.ops.object.light_add(type="SUN", location=(2, -6, 8))
+    return cart, pole
+
+
+class CartpoleEnv(btb.BaseEnv):
+    """Velocity-integrating cart motor + passive pole, reward 1 while the
+    pole stays up (reference ``cartpole.blend.py:22-43``)."""
+
+    def __init__(self, agent, cart, pole, fps=30.0, mass=0.5):
+        super().__init__(agent)
+        self.cart = cart
+        self.pole = pole
+        self.fps = fps
+        self.mass = mass
+        self.velocity = 0.0
+
+    def _env_reset(self):
+        self.velocity = 0.0
+        self.cart.location = (0.0, 0.0, 0.5)
+        self.pole.location = (0.0, 0.0, 2.0)
+        self.pole.rotation_euler = (0.0, 0.05, 0.0)  # slight tilt
+
+    def _env_prepare_step(self, action):
+        # motor model: force -> velocity delta before physics integrates
+        self.velocity += (float(action) / self.mass) / self.fps
+        self.cart.location.x += self.velocity / self.fps
+
+    def _env_post_step(self):
+        c_x = float(self.cart.matrix_world.translation.x)
+        p_x = float(self.pole.matrix_world.translation.x)
+        angle = float(self.pole.rotation_euler.y)
+        done = abs(angle) > 0.6 or abs(c_x) > 4.0
+        return {
+            "obs": (c_x, p_x, angle),
+            "reward": 0.0 if done else 1.0,
+            "done": done,
+        }
+
+
+def main():
+    btargs, remainder = btb.parse_blendtorch_args()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--render-every", type=int, default=10)
+    parser.add_argument("--real-time", action="store_true")
+    parser.add_argument("--no-real-time", dest="real_time", action="store_false")
+    args = parser.parse_args(remainder)
+
+    cart, pole = build_scene()
+    agent = btb.RemoteControlledAgent(
+        btargs.btsockets["GYM"], real_time=args.real_time
+    )
+    env = CartpoleEnv(agent, cart, pole)
+    env.attach_default_renderer(every_nth=args.render_every)
+    env.run(frame_range=(1, 10000), use_animation=True)
+
+
+main()

@@ -1,0 +1,28 @@
+"""P-controller cartpole demo (counterpart of reference
+``examples/control/cartpole.py:19-35``): launch the Blender cartpole, keep
+the pole upright with a proportional controller, render occasionally."""
+
+from pathlib import Path
+
+from blendjax.btt.env import launch_env
+
+SCRIPT = Path(__file__).parent / "cartpole.blend.py"
+
+
+def control(obs):
+    _, _, angle = obs
+    return 35.0 * angle  # push toward the lean
+
+
+def main():
+    with launch_env(scene="", script=str(SCRIPT), real_time=False) as env:
+        obs, _ = env.reset()
+        for _ in range(1000):
+            obs, reward, done, info = env.step(control(obs))
+            env.render()
+            if done:
+                obs, _ = env.reset()
+
+
+if __name__ == "__main__":
+    main()

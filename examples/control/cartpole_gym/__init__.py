@@ -1,0 +1,17 @@
+"""Gym registration for the Blender cartpole (counterpart of reference
+``examples/control/cartpole_gym/__init__.py``).  Importing this package
+registers ``blendjax-cartpole-v0`` when gym/gymnasium is installed."""
+
+try:
+    import gymnasium as _gym
+except ImportError:
+    try:
+        import gym as _gym
+    except ImportError:
+        _gym = None
+
+if _gym is not None:
+    _gym.register(
+        id="blendjax-cartpole-v0",
+        entry_point="cartpole_gym.envs:CartpoleEnv",
+    )

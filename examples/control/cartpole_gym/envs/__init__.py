@@ -1,0 +1,1 @@
+from cartpole_gym.envs.cartpole_env import CartpoleEnv  # noqa: F401

@@ -1,0 +1,28 @@
+"""Gym-compatible cartpole env (counterpart of reference
+``examples/control/cartpole_gym/envs/cartpole_env.py``): thin subclass of
+OpenAIRemoteEnv that launches the Blender cartpole script."""
+
+from pathlib import Path
+
+import numpy as np
+
+from blendjax.btt.env import OpenAIRemoteEnv
+
+SCRIPT = Path(__file__).parents[2] / "cartpole.blend.py"
+
+
+class CartpoleEnv(OpenAIRemoteEnv):
+    def __init__(self, render_every=10, real_time=False):
+        super().__init__(version="0.1.0")
+        self.launch(
+            scene="",
+            script=str(SCRIPT),
+            real_time=real_time,
+            render_every=render_every,
+        )
+        import gymnasium as gym  # or gym; whichever registered us
+
+        self.action_space = gym.spaces.Box(-40.0, 40.0, shape=(1,), dtype=np.float32)
+        self.observation_space = gym.spaces.Box(
+            -10.0, 10.0, shape=(3,), dtype=np.float32
+        )

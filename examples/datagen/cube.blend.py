@@ -1,0 +1,61 @@
+"""Producer script: randomized rotating cube with keypoint annotations
+(counterpart of reference ``examples/datagen/cube.blend.py`` — same
+published message schema ``{image, xy, frameid}``).
+
+Runs inside Blender:
+    blender --python cube.blend.py -- -btid 0 -btseed 0 -btsockets DATA=...
+(normally via ``BlenderLauncher(scene='', script='cube.blend.py', ...)``).
+
+Unlike the reference this needs no checked-in ``.blend`` scene: the cube,
+camera, and light are created procedurally, so the example is fully
+self-contained.
+"""
+
+import bpy
+import numpy as np
+
+from blendjax import btb
+
+
+def build_scene():
+    """Cube + camera + sun on an empty scene (replaces cube.blend)."""
+    for obj in list(bpy.data.objects):
+        bpy.data.objects.remove(obj, do_unlink=True)
+    bpy.ops.mesh.primitive_cube_add(size=2.0, location=(0, 0, 0))
+    cube = bpy.context.active_object
+    bpy.ops.object.camera_add(location=(0, -8, 2))
+    cam = bpy.context.active_object
+    bpy.context.scene.camera = cam
+    bpy.ops.object.light_add(type="SUN", location=(3, -4, 6))
+    bpy.context.scene.render.resolution_x = 640
+    bpy.context.scene.render.resolution_y = 480
+    bpy.context.scene.render.resolution_percentage = 100
+    return cube, cam
+
+
+def main():
+    args, remainder = btb.parse_blendtorch_args()
+    rng = np.random.default_rng(args.btseed)
+
+    cube, _ = build_scene()
+    cam = btb.Camera()
+    off = btb.OffScreenRenderer(camera=cam, mode="rgb")
+    off.set_render_style(shading="RENDERED", overlays=False)
+    pub = btb.DataPublisher(args.btsockets["DATA"], btid=args.btid)
+
+    anim = btb.AnimationController()
+
+    def randomize():
+        cube.rotation_euler = rng.uniform(0, np.pi, size=3)
+
+    def publish(anim):
+        img = off.render()
+        xy = cam.object_to_pixel(cube)
+        pub.publish(image=img, xy=xy.astype(np.float32), frameid=anim.frameid)
+
+    anim.pre_frame.add(randomize)
+    anim.post_frame.add(publish, anim)
+    anim.play(frame_range=(0, 100), num_episodes=-1)
+
+
+main()

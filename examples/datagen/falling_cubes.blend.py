@@ -1,0 +1,74 @@
+"""Producer script: physics-driven falling cubes with randomized materials
+(counterpart of reference ``examples/datagen/falling_cubes.blend.py`` —
+publishes ``{image, frameid}`` per frame while rigid-body physics runs).
+
+Scene is built procedurally: a ground plane plus N rigid-body cubes dropped
+from random heights each episode; the rigid-body cache is synced to the
+frame range by ``AnimationController.setup_frame_range`` so physics restarts
+cleanly every episode.
+"""
+
+import bpy
+import numpy as np
+
+from blendjax import btb
+
+NUM_CUBES = 8
+
+
+def build_scene(rng):
+    for obj in list(bpy.data.objects):
+        bpy.data.objects.remove(obj, do_unlink=True)
+
+    bpy.ops.mesh.primitive_plane_add(size=20.0, location=(0, 0, 0))
+    plane = bpy.context.active_object
+    bpy.ops.rigidbody.object_add({"object": plane})
+    plane.rigid_body.type = "PASSIVE"
+
+    cubes = []
+    for _ in range(NUM_CUBES):
+        bpy.ops.mesh.primitive_cube_add(size=1.0)
+        cube = bpy.context.active_object
+        bpy.ops.rigidbody.object_add({"object": cube})
+        mat = bpy.data.materials.new(name="rand")
+        mat.diffuse_color = (*rng.uniform(0.1, 1.0, size=3), 1.0)
+        cube.data.materials.append(mat)
+        cubes.append(cube)
+
+    bpy.ops.object.camera_add(location=(0, -16, 6))
+    cam = bpy.context.active_object
+    bpy.context.scene.camera = cam
+    bpy.ops.object.light_add(type="SUN", location=(4, -4, 10))
+    bpy.context.scene.render.resolution_x = 640
+    bpy.context.scene.render.resolution_y = 480
+    return cubes
+
+
+def main():
+    args, _ = btb.parse_blendtorch_args()
+    rng = np.random.default_rng(args.btseed)
+
+    cubes = build_scene(rng)
+    cam = btb.Camera()
+    cam.look_at(look_at=(0, 0, 2), look_from=(0, -16, 6))
+    off = btb.OffScreenRenderer(camera=cam, mode="rgb")
+    off.set_render_style(shading="RENDERED", overlays=False)
+    pub = btb.DataPublisher(args.btsockets["DATA"], btid=args.btid)
+
+    anim = btb.AnimationController()
+
+    def drop_cubes():
+        for cube in cubes:
+            cube.location = (*rng.uniform(-4, 4, size=2), rng.uniform(4, 10))
+            cube.rotation_euler = rng.uniform(0, np.pi, size=3)
+
+    def publish(anim):
+        pub.publish(image=off.render(), frameid=anim.frameid)
+
+    anim.pre_animation.add(drop_cubes)
+    anim.post_frame.add(publish, anim)
+    # physics=True (default) syncs the rigid-body cache to this range
+    anim.play(frame_range=(0, 100), num_episodes=-1)
+
+
+main()

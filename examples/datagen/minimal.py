@@ -1,0 +1,29 @@
+"""Minimal streaming demo (counterpart of reference
+``examples/datagen/minimal.py``): launch two Blender cube producers, pull a
+handful of annotated frames, print shapes.
+
+Run on a host with Blender installed:
+    python minimal.py
+"""
+
+from pathlib import Path
+
+from blendjax import btt
+
+SCRIPT = Path(__file__).parent / "cube.blend.py"
+
+
+def main():
+    with btt.BlenderLauncher(
+        scene="", script=str(SCRIPT), num_instances=2, named_sockets=["DATA"]
+    ) as bl:
+        ds = btt.RemoteIterableDataset(bl.launch_info.addresses["DATA"], max_items=8)
+        for item in ds:
+            print(
+                f"btid={item['btid']} frame={item['frameid']} "
+                f"image={item['image'].shape} xy={item['xy'].shape}"
+            )
+
+
+if __name__ == "__main__":
+    main()

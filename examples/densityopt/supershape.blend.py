@@ -1,0 +1,99 @@
+"""Producer script: duplex-controlled supershape renderer (counterpart of
+reference ``examples/densityopt/supershape.blend.py`` — same message flow:
+non-blocking duplex recv each pre_frame applies new shape params; post_frame
+publishes ``{image, shape_id}``).
+
+The reference depends on an external ``supershape`` package; blendjax
+inlines the Gielis superformula mesh generator so the example is
+self-contained.
+"""
+
+import bpy
+import numpy as np
+
+from blendjax import btb
+
+
+def superformula(theta, m, n1=2.0, n2=4.0, n3=4.0, a=1.0, b=1.0):
+    """Gielis superformula radius for angle array ``theta``."""
+    t = m * theta / 4.0
+    raw = np.abs(np.cos(t) / a) ** n2 + np.abs(np.sin(t) / b) ** n3
+    return raw ** (-1.0 / n1)
+
+
+def supershape_vertices(m1, m2, res=48):
+    """(res*res, 3) vertex grid of a 3-D supershape."""
+    theta = np.linspace(-np.pi, np.pi, res)
+    phi = np.linspace(-np.pi / 2, np.pi / 2, res)
+    r1 = superformula(theta, m1)
+    r2 = superformula(phi, m2)
+    T, P = np.meshgrid(theta, phi, indexing="ij")
+    R1, R2 = np.meshgrid(r1, r2, indexing="ij")
+    x = R1 * np.cos(T) * R2 * np.cos(P)
+    y = R1 * np.sin(T) * R2 * np.cos(P)
+    z = R2 * np.sin(P)
+    return np.stack([x, y, z], axis=-1).reshape(-1, 3), res
+
+
+def make_mesh(m1, m2, obj=None, res=48):
+    """Create/update a supershape mesh object from (m1, m2)."""
+    verts, n = supershape_vertices(m1, m2, res)
+    faces = []
+    for i in range(n - 1):
+        for j in range(n - 1):
+            a = i * n + j
+            faces.append((a, a + 1, a + n + 1, a + n))
+    mesh = bpy.data.meshes.new("supershape")
+    mesh.from_pydata(verts.tolist(), [], faces)
+    mesh.update()
+    if obj is None:
+        obj = bpy.data.objects.new("supershape", mesh)
+        bpy.context.collection.objects.link(obj)
+    else:
+        old = obj.data
+        obj.data = mesh
+        bpy.data.meshes.remove(old)
+    return obj
+
+
+def build_scene():
+    for o in list(bpy.data.objects):
+        bpy.data.objects.remove(o, do_unlink=True)
+    bpy.ops.object.camera_add(location=(0, -6, 0))
+    bpy.context.scene.camera = bpy.context.active_object
+    bpy.ops.object.light_add(type="SUN", location=(2, -4, 4))
+    bpy.context.scene.render.resolution_x = 128
+    bpy.context.scene.render.resolution_y = 128
+
+
+def main():
+    args, _ = btb.parse_blendtorch_args()
+
+    build_scene()
+    obj = make_mesh(3.0, 3.0)
+    cam = btb.Camera()
+    off = btb.OffScreenRenderer(camera=cam, mode="rgb")
+    pub = btb.DataPublisher(args.btsockets["DATA"], btid=args.btid)
+    duplex = btb.DuplexChannel(args.btsockets["CTRL"], btid=args.btid)
+
+    state = {"obj": obj, "shape_id": -1, "params": (3.0, 3.0)}
+    anim = btb.AnimationController()
+
+    def apply_params():
+        msg = duplex.recv(timeoutms=0)  # non-blocking, reference pattern
+        if msg is not None:
+            m1, m2 = msg["shape_params"]
+            state["obj"] = make_mesh(float(m1), float(m2), state["obj"])
+            state["shape_id"] = msg["shape_id"]
+            state["params"] = (m1, m2)
+
+    def publish():
+        if state["shape_id"] >= 0:
+            pub.publish(image=off.render(), shape_id=state["shape_id"])
+
+    anim.pre_frame.add(apply_params)
+    anim.post_frame.add(publish)
+    anim.play(frame_range=(0, 10000), num_episodes=-1)
+
+
+main()

@@ -1,7 +1,19 @@
 """Test helpers: fake Blender executable + fleet utilities."""
 
+import importlib.util
 import os
 
 HELPER_DIR = os.path.dirname(os.path.abspath(__file__))
 FAKE_BLENDER = os.path.join(HELPER_DIR, "fake_blender.py")
 BLEND_SCRIPTS = os.path.join(os.path.dirname(HELPER_DIR), "blender")
+REPO_ROOT = os.path.dirname(os.path.dirname(HELPER_DIR))
+
+
+def load_example(relpath):
+    """Import an examples/ script as a module (they are not packaged)."""
+    path = os.path.join(REPO_ROOT, "examples", relpath)
+    name = "example_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
