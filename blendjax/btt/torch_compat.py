@@ -1,0 +1,51 @@
+"""Optional torch interop for users migrating from the reference.
+
+The reference consumer is built on ``torch.utils.data``
+(``btt/dataset.py:14,119,134``); blendjax is torch-free but a one-liner
+bridges back: wrap any blendjax dataset for a torch ``DataLoader``.  Import
+of this module requires torch; nothing else in blendjax does.
+
+    from blendjax.btt.torch_compat import as_torch_iterable
+    loader = torch.utils.data.DataLoader(as_torch_iterable(ds), batch_size=8,
+                                         num_workers=4)
+"""
+
+from __future__ import annotations
+
+import torch.utils.data as _tud
+
+
+class TorchIterableAdapter(_tud.IterableDataset):
+    """Presents a blendjax RemoteIterableDataset to torch DataLoaders.
+
+    Worker sharding matches the reference: each DataLoader worker streams
+    ``max_items // num_workers`` items (handled inside
+    ``RemoteIterableDataset.__iter__`` via ``get_worker_info``).
+    """
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+
+    def __iter__(self):
+        return iter(self.dataset)
+
+
+class TorchMapAdapter(_tud.Dataset):
+    """Presents FileDataset/SingleFileDataset map-style replays to torch."""
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, idx):
+        return self.dataset[idx]
+
+
+def as_torch_iterable(dataset):
+    return TorchIterableAdapter(dataset)
+
+
+def as_torch_map(dataset):
+    return TorchMapAdapter(dataset)

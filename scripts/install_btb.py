@@ -1,0 +1,66 @@
+"""Install the blendjax producer package into Blender's bundled Python
+(counterpart of reference ``scripts/install_btb.py:22-41``).
+
+Blender ships its own Python interpreter; the producer side (zmq + numpy +
+blendjax) must be importable *there*, not in your training venv.  This
+script locates that interpreter via Blender itself, bootstraps pip with
+``ensurepip``, and installs blendjax (editable, from this checkout) plus
+producer requirements.
+
+Usage (from the repo root, with ``blender`` on PATH or $BLENDJAX_BLENDER):
+    python scripts/install_btb.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+_FIND_PY = r"""
+import sys
+print(sys.executable)
+"""
+
+
+def blender_python(blender_cmd):
+    """Path of Blender's embedded interpreter."""
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as fp:
+        fp.write(_FIND_PY)
+        probe = fp.name
+    try:
+        out = subprocess.run(
+            [blender_cmd, "--background", "--python-exit-code", "255", "--python", probe],
+            capture_output=True,
+            text=True,
+            check=True,
+        )
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(("python", "python3")) or "python" in Path(line).name:
+                if Path(line).exists():
+                    return line
+        raise RuntimeError(f"Could not parse interpreter path from:\n{out.stdout}")
+    finally:
+        os.unlink(probe)
+
+
+def main():
+    blender_cmd = os.environ.get("BLENDJAX_BLENDER", "blender")
+    py = blender_python(blender_cmd)
+    print(f"Blender's Python: {py}")
+    subprocess.run([py, "-m", "ensurepip", "--upgrade"], check=False)
+    subprocess.run(
+        [py, "-m", "pip", "install", "--upgrade", "pip", "pyzmq>=18.1", "numpy>=1.18"],
+        check=True,
+    )
+    subprocess.run([py, "-m", "pip", "install", "-e", str(REPO)], check=True)
+    print("blendjax producer package installed into Blender.")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Torch interop shim: blendjax datasets must work under torch DataLoader
+(worker-sharding semantics matching the reference's torch-native consumer)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from blendjax.btt.dataset import RemoteIterableDataset  # noqa: E402
+from blendjax.btt.file import FileRecorder  # noqa: E402
+from blendjax.btt.torch_compat import as_torch_iterable, as_torch_map  # noqa: E402
+from helpers.producers import ProducerFleet  # noqa: E402
+
+
+def test_torch_dataloader_over_stream():
+    with ProducerFleet(num_producers=1, shape=(8, 8, 3)) as fleet:
+        ds = RemoteIterableDataset(fleet.addresses, max_items=8)
+        loader = torch.utils.data.DataLoader(
+            as_torch_iterable(ds), batch_size=4, num_workers=0
+        )
+        batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0]["image"].shape == (4, 8, 8, 3)
+    assert batches[0]["image"].dtype == torch.uint8
+
+
+def test_torch_map_adapter(tmp_path):
+    from blendjax.btt.dataset import FileDataset
+
+    prefix = str(tmp_path / "rec")
+    with FileRecorder(f"{prefix}_00.btr", max_messages=8) as rec:
+        for i in range(4):
+            rec.save({"image": np.full((2, 2), i, np.uint8), "frameid": i})
+    ds = as_torch_map(FileDataset(prefix))
+    assert len(ds) == 4
+    loader = torch.utils.data.DataLoader(ds, batch_size=2, shuffle=True)
+    total = sum(int(b["frameid"].sum()) for b in loader)
+    assert total == 0 + 1 + 2 + 3
