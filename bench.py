@@ -26,6 +26,18 @@ BASELINE_IMAGES_PER_SEC = 1.0 / 0.012
 
 def main():
     sys.path.insert(0, ".")
+    import os
+
+    # honor $JAX_PLATFORMS even when sitecustomize pre-registers a backend
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
     from benchmarks.benchmark import parse_args, run
 
     args = parse_args(

@@ -60,7 +60,15 @@ def launch_producers(n, raw, width, height):
 
 
 def run(args):
+    # honor $JAX_PLATFORMS even when sitecustomize pre-registers a backend
+    plat = os.environ.get("JAX_PLATFORMS")
     import jax
+
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
 
     from blendjax.btt.dataset import RemoteIterableDataset
     from blendjax.btt.prefetch import JaxStream

@@ -45,9 +45,20 @@ class DataPublisher:
         raw_buffers=False,
         lingerms=0,
         sndtimeoms=None,
+        shm_capacity=64 << 20,
     ):
         self.btid = btid
         self.raw_buffers = raw_buffers
+        self._sndtimeoms = -1 if sndtimeoms is None else sndtimeoms
+        self.sock = None
+        self._ring = None
+        if bind_address.startswith("shm://"):
+            # same-host native transport: single memcpy into a shared-memory
+            # ring, no tcp/kernel copies (see blendjax/native/ringbuf.cpp)
+            from blendjax.native import ShmRingWriter
+
+            self._ring = ShmRingWriter(bind_address, capacity_bytes=shm_capacity)
+            return
         self._ctx = zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.PUSH)
         self.sock.setsockopt(zmq.SNDHWM, send_hwm)
@@ -66,6 +77,9 @@ class DataPublisher:
         blendjax extension, the reference blocks indefinitely).
         """
         data = {wire.BTID_KEY: self.btid, **kwargs}
+        if self._ring is not None:
+            frames = wire.encode(data, raw_buffers=self.raw_buffers)
+            return self._ring.send_frames(frames, timeout_ms=self._sndtimeoms)
         try:
             wire.send_message(self.sock, data, raw_buffers=self.raw_buffers)
         except zmq.Again:
@@ -73,4 +87,9 @@ class DataPublisher:
         return True
 
     def close(self):
-        self.sock.close(0)
+        if self._ring is not None:
+            self._ring.close(unlink=False)  # reader may still drain
+            self._ring = None
+        if self.sock is not None:
+            self.sock.close(0)
+            self.sock = None

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import bisect
 import sys
+import time
 from contextlib import ExitStack
 from glob import glob
 
@@ -113,7 +114,17 @@ class RemoteIterableDataset:
         ``stop_event`` (a ``threading.Event``) aborts the stream promptly —
         the poll loop checks it between messages so loaders can shut down
         without waiting out ``timeoutms``.
+
+        ``shm://`` addresses take the native shared-memory path (see
+        :mod:`blendjax.native.ring`): rings are single-consumer, so they are
+        partitioned ``addresses[worker_id::num_workers]`` instead of the
+        ZMQ connect-to-all fan-in; use ``num_workers <= len(addresses)``.
         """
+        if self.addresses and all(a.startswith("shm://") for a in self.addresses):
+            yield from self._stream_shm(
+                worker_id, num_workers, shard_id, num_shards, stop_event
+            )
+            return
         ctx = zmq.Context.instance()
         socket = ctx.socket(zmq.PULL)
         socket.setsockopt(zmq.RCVHWM, self.queue_size)
@@ -160,6 +171,64 @@ class RemoteIterableDataset:
                     yield self._item(obj)
         finally:
             socket.close(0)
+
+    def _stream_shm(self, worker_id, num_workers, shard_id, num_shards, stop_event):
+        """Native-transport variant of the stream loop: round-robin over
+        this worker's rings; a closed+drained ring leaves the rotation
+        (producer exit ends the stream instead of raising a timeout)."""
+        from blendjax.native import ShmRingReader
+
+        mine = self.addresses[worker_id::num_workers]
+        if not mine:
+            return
+        readers = [ShmRingReader(a) for a in mine]
+        count = self.max_items // (num_workers * num_shards)
+        try:
+            with ExitStack() as es:
+                rec = None
+                if self.record_path_prefix is not None:
+                    rec = es.enter_context(
+                        FileRecorder(
+                            FileRecorder.filename(
+                                self.record_path_prefix,
+                                shard_id * num_workers + worker_id,
+                            ),
+                            self.max_items,
+                        )
+                    )
+                delivered = 0
+                waited_ms = 0
+                slice_ms = 20
+                while delivered < count and readers:
+                    progressed = False
+                    for reader in list(readers):
+                        if stop_event is not None and stop_event.is_set():
+                            return
+                        try:
+                            frames = reader.recv_frames(timeout_ms=0)
+                        except EOFError:
+                            readers.remove(reader)
+                            continue
+                        if frames is None:
+                            continue
+                        progressed = True
+                        waited_ms = 0
+                        if rec is not None:
+                            rec.save_frames(frames)
+                        yield self._item(wire.decode(frames))
+                        delivered += 1
+                        if delivered >= count:
+                            return
+                    if not progressed:
+                        time.sleep(slice_ms / 1000.0)
+                        waited_ms += slice_ms
+                        if waited_ms >= self.timeoutms:
+                            raise TimeoutError(
+                                f"No message within {self.timeoutms} ms from {mine}"
+                            )
+        finally:
+            for r in readers:
+                r.close()
 
     def _item(self, item):
         """Override point; defaults to ``item_transform`` (reference

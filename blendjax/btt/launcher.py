@@ -55,7 +55,8 @@ class BlenderLauncher:
     instance_args: list[list[str]] | None
         Extra per-instance CLI args appended after the framework args.
     proto: str
-        ZMQ transport, ``'tcp'`` (default) or ``'ipc'``.
+        Transport: ``'tcp'`` (default), ``'ipc'``, or ``'shm'`` (native
+        same-host shared-memory rings, see :mod:`blendjax.native.ring`).
     blend_path: str | None
         Extra PATH entries searched for the Blender executable.
     seed: int | None
@@ -135,6 +136,8 @@ class BlenderLauncher:
             for idx in range(self.num_instances):
                 if self.proto == "ipc":
                     addrs.append(f"ipc:///tmp/blendjax-{name}-{port + idx}.ipc")
+                elif self.proto == "shm":
+                    addrs.append(f"shm://blendjax-{name}-{port + idx}")
                 else:
                     addrs.append(f"{self.proto}://{bind}:{port + idx}")
             port += self.num_instances

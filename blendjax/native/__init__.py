@@ -1,0 +1,10 @@
+"""Native (C++) components.  Built on demand with the bundled Makefile;
+everything here is optional — the pure-ZMQ paths work without it."""
+
+from blendjax.native.ring import (  # noqa: F401
+    ShmRingReader,
+    ShmRingWriter,
+    is_shm_address,
+    native_available,
+    shm_name_from_address,
+)

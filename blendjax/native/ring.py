@@ -1,0 +1,185 @@
+"""ctypes bindings for the native SPSC shared-memory ring
+(``ringbuf.cpp``) + message framing shared with the wire protocol.
+
+Address scheme: ``shm://<name>`` — accepted directly by
+:class:`blendjax.btb.publisher.DataPublisher` (writer side binds/creates)
+and :class:`blendjax.btt.dataset.RemoteIterableDataset` (reader side
+opens).  The launcher allocates these like tcp addresses when
+``proto='shm'``.
+
+Message framing inside a ring record re-uses the multipart wire encoding
+(:func:`blendjax.wire.encode`): ``u32 nframes``, then per frame ``u64 len``
++ bytes.  Arrays decode as views into the shm arena and are copied out
+before release (one copy total; the tcp path costs a pickle copy + two
+kernel copies).
+
+The .so builds on first use via the bundled Makefile (g++); if no compiler
+is available, ``native_available()`` returns False and callers should fall
+back to tcp.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libblendjax_ring.so")
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+_LIB_ERR = None
+
+
+def _load():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        try:
+            if not os.path.exists(_SO):
+                subprocess.run(
+                    ["make", "-s"], cwd=_DIR, check=True, capture_output=True
+                )
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError) as e:
+            _LIB_ERR = e
+            return None
+        lib.bjr_create.restype = ctypes.c_void_p
+        lib.bjr_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.bjr_open.restype = ctypes.c_void_p
+        lib.bjr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.bjr_write.restype = ctypes.c_int
+        lib.bjr_write.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.bjr_read_acquire.restype = ctypes.c_int
+        lib.bjr_read_acquire.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+        ]
+        lib.bjr_read_release.argtypes = [ctypes.c_void_p]
+        lib.bjr_pending.restype = ctypes.c_uint64
+        lib.bjr_pending.argtypes = [ctypes.c_void_p]
+        lib.bjr_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def is_shm_address(address: str) -> bool:
+    return isinstance(address, str) and address.startswith("shm://")
+
+
+def shm_name_from_address(address: str) -> str:
+    name = address[len("shm://"):]
+    return name if name.startswith("/") else "/" + name
+
+
+def _pack_frames(frames) -> bytes:
+    parts = [struct.pack("<I", len(frames))]
+    for f in frames:
+        b = bytes(f)
+        parts.append(struct.pack("<Q", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _unpack_frames(buf: memoryview):
+    (nframes,) = struct.unpack_from("<I", buf, 0)
+    off = 4
+    frames = []
+    for _ in range(nframes):
+        (ln,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        frames.append(bytes(buf[off : off + ln]))  # copy out of shm
+        off += ln
+    return frames
+
+
+class ShmRingWriter:
+    """Producer end of a shm ring (DataPublisher backend)."""
+
+    def __init__(self, address, capacity_bytes=64 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native ring unavailable (build failed: {_LIB_ERR}); use tcp"
+            )
+        self._lib = lib
+        name = shm_name_from_address(address)
+        self._h = lib.bjr_create(name.encode(), capacity_bytes)
+        if not self._h:
+            raise OSError(f"failed to create shm ring {name}")
+
+    def send_frames(self, frames, timeout_ms=-1) -> bool:
+        """Write one framed message; False on timeout (backpressure)."""
+        payload = _pack_frames(frames)
+        rc = self._lib.bjr_write(self._h, payload, len(payload), timeout_ms)
+        if rc == -2:
+            raise ValueError("message larger than ring capacity")
+        return rc == 0
+
+    def pending_bytes(self):
+        return self._lib.bjr_pending(self._h)
+
+    def close(self, unlink=True):
+        if self._h:
+            self._lib.bjr_close(self._h, int(unlink))
+            self._h = None
+
+
+class ShmRingReader:
+    """Consumer end of a shm ring (dataset backend)."""
+
+    def __init__(self, address, open_timeout_ms=10000):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native ring unavailable (build failed: {_LIB_ERR}); use tcp"
+            )
+        self._lib = lib
+        name = shm_name_from_address(address)
+        self._h = lib.bjr_open(name.encode(), open_timeout_ms)
+        if not self._h:
+            raise OSError(f"failed to open shm ring {name}")
+
+    def recv_frames(self, timeout_ms):
+        """Next framed message as a list of byte frames, or None on timeout.
+
+        Raises EOFError when the producer closed and the ring is drained.
+        """
+        data = ctypes.c_void_p()
+        length = ctypes.c_uint64()
+        rc = self._lib.bjr_read_acquire(
+            self._h, ctypes.byref(data), ctypes.byref(length), timeout_ms
+        )
+        if rc == -1:
+            return None
+        if rc == -3:
+            raise EOFError("producer closed")
+        try:
+            buf = (ctypes.c_char * length.value).from_address(data.value)
+            return _unpack_frames(memoryview(buf))
+        finally:
+            self._lib.bjr_read_release(self._h)
+
+    def pending_bytes(self):
+        return self._lib.bjr_pending(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.bjr_close(self._h, 0)
+            self._h = None

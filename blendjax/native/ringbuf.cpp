@@ -1,0 +1,239 @@
+// blendjax native transport: SPSC shared-memory byte ring.
+//
+// Same-host Blender->consumer frame transport that bypasses the tcp
+// loopback path (ZMQ frame copy -> kernel send -> kernel recv -> consumer
+// copy) with a single producer-side memcpy into a POSIX shm arena the
+// consumer reads in place.  The reference framework has no native
+// components (its hot path is pickle+tcp, SURVEY.md §0); this is the
+// blendjax equivalent of owning the IPC layer natively.
+//
+// Layout:  [Header | byte arena]
+// Records: u64 length, payload, padded to 8 bytes.  A length of
+// UINT64_MAX is a wrap marker: the reader skips to the arena start.
+// Single producer / single consumer, lock-free (acquire/release atomics),
+// bounded: a full ring blocks the producer (same backpressure contract as
+// the ZMQ HWM path, publisher.py).
+//
+// C ABI for ctypes; no exceptions cross the boundary.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x424a5852494e4701ULL;  // "BJXRING" v1
+constexpr uint64_t kWrapMarker = ~0ULL;
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;                  // arena size in bytes (multiple of 8)
+  std::atomic<uint64_t> head;         // producer: total bytes written
+  std::atomic<uint64_t> tail;         // consumer: total bytes consumed
+  std::atomic<uint32_t> producer_closed;
+  uint32_t _pad;
+};
+
+struct Handle {
+  Header* hdr;
+  uint8_t* arena;
+  uint64_t map_size;
+  char name[256];
+  int owner;          // created (vs opened)
+  uint64_t last_rec;  // bytes to release after read_acquire
+};
+
+inline uint64_t pad8(uint64_t n) { return (n + 7) & ~7ULL; }
+
+inline void sleep_us(unsigned us) {
+  struct timespec ts = {0, static_cast<long>(us) * 1000L};
+  nanosleep(&ts, nullptr);
+}
+
+inline uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000ULL + ts.tv_nsec / 1000000ULL;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a ring (producer side).  capacity is rounded up to 8.
+// Returns nullptr on failure.
+void* bjr_create(const char* name, uint64_t capacity) {
+  capacity = pad8(capacity);
+  shm_unlink(name);  // stale ring from a crashed producer
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_size = sizeof(Header) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = new (mem) Header();
+  hdr->capacity = capacity;
+  hdr->head.store(0, std::memory_order_relaxed);
+  hdr->tail.store(0, std::memory_order_relaxed);
+  hdr->producer_closed.store(0, std::memory_order_relaxed);
+  hdr->magic = kMagic;  // published last
+
+  auto* h = new Handle();
+  h->hdr = hdr;
+  h->arena = reinterpret_cast<uint8_t*>(mem) + sizeof(Header);
+  h->map_size = map_size;
+  std::strncpy(h->name, name, sizeof(h->name) - 1);
+  h->owner = 1;
+  h->last_rec = 0;
+  return h;
+}
+
+// Open an existing ring (consumer side).  Waits up to timeout_ms for the
+// producer to create it.  Returns nullptr on failure/timeout.
+void* bjr_open(const char* name, int timeout_ms) {
+  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms < 0 ? 0 : timeout_ms);
+  int fd = -1;
+  for (;;) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    if (timeout_ms >= 0 && now_ms() >= deadline) return nullptr;
+    sleep_us(200);
+  }
+  struct stat st;
+  while (fstat(fd, &st) == 0 &&
+         st.st_size < static_cast<off_t>(sizeof(Header))) {
+    if (timeout_ms >= 0 && now_ms() >= deadline) {
+      close(fd);
+      return nullptr;
+    }
+    sleep_us(200);
+  }
+  uint64_t map_size = static_cast<uint64_t>(st.st_size);
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = reinterpret_cast<Header*>(mem);
+  while (hdr->magic != kMagic) {  // producer still initializing
+    if (timeout_ms >= 0 && now_ms() >= deadline) {
+      munmap(mem, map_size);
+      return nullptr;
+    }
+    sleep_us(200);
+  }
+  auto* h = new Handle();
+  h->hdr = hdr;
+  h->arena = reinterpret_cast<uint8_t*>(mem) + sizeof(Header);
+  h->map_size = map_size;
+  std::strncpy(h->name, name, sizeof(h->name) - 1);
+  h->owner = 0;
+  h->last_rec = 0;
+  return h;
+}
+
+// Write one record.  Blocks (bounded backpressure) until space or timeout.
+// Returns 0 ok, -1 timeout, -2 message larger than ring.
+int bjr_write(void* handle, const void* data, uint64_t len, int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  Header* hdr = h->hdr;
+  const uint64_t cap = hdr->capacity;
+  const uint64_t need = 8 + pad8(len);
+  if (need + 8 > cap) return -2;  // +8: wrap marker headroom
+
+  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms < 0 ? 0 : timeout_ms);
+  uint64_t head = hdr->head.load(std::memory_order_relaxed);
+
+  for (;;) {
+    uint64_t tail = hdr->tail.load(std::memory_order_acquire);
+    uint64_t pos = head % cap;
+    uint64_t to_end = cap - pos;
+    // wrap cost if the record cannot sit contiguously before the end
+    uint64_t total = (to_end < need) ? to_end + need : need;
+    if (cap - (head - tail) >= total) {
+      if (to_end < need) {
+        // wrap marker, then restart at arena begin
+        std::memcpy(h->arena + pos, &kWrapMarker, 8);
+        head += to_end;
+        pos = 0;
+      }
+      std::memcpy(h->arena + pos, &len, 8);
+      std::memcpy(h->arena + pos + 8, data, len);
+      hdr->head.store(head + 8 + pad8(len), std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    sleep_us(100);
+  }
+}
+
+// Acquire the next record without copying.  *data points into the shm
+// arena and stays valid until bjr_read_release.  Returns 0 ok, -1 timeout,
+// -3 producer closed and ring drained.
+int bjr_read_acquire(void* handle, const void** data, uint64_t* len,
+                     int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  Header* hdr = h->hdr;
+  const uint64_t cap = hdr->capacity;
+  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms < 0 ? 0 : timeout_ms);
+
+  for (;;) {
+    uint64_t tail = hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = hdr->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint64_t pos = tail % cap;
+      uint64_t rec_len;
+      std::memcpy(&rec_len, h->arena + pos, 8);
+      if (rec_len == kWrapMarker) {
+        hdr->tail.store(tail + (cap - pos), std::memory_order_release);
+        continue;
+      }
+      *data = h->arena + pos + 8;
+      *len = rec_len;
+      h->last_rec = 8 + pad8(rec_len);
+      return 0;
+    }
+    if (hdr->producer_closed.load(std::memory_order_acquire)) return -3;
+    if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    sleep_us(100);
+  }
+}
+
+void bjr_read_release(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  if (h->last_rec) {
+    h->hdr->tail.fetch_add(h->last_rec, std::memory_order_release);
+    h->last_rec = 0;
+  }
+}
+
+// Number of unread bytes currently buffered (diagnostics).
+uint64_t bjr_pending(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  return h->hdr->head.load(std::memory_order_acquire) -
+         h->hdr->tail.load(std::memory_order_acquire);
+}
+
+void bjr_close(void* handle, int unlink_shm) {
+  auto* h = static_cast<Handle*>(handle);
+  if (h->owner) h->hdr->producer_closed.store(1, std::memory_order_release);
+  munmap(reinterpret_cast<void*>(h->hdr), h->map_size);
+  if (unlink_shm) shm_unlink(h->name);
+  delete h;
+}
+
+}  // extern "C"

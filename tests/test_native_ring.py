@@ -1,0 +1,167 @@
+"""Native shm-ring transport tests: raw ring semantics (wraparound,
+backpressure, EOF), the framed reader/writer pair, and the full
+DataPublisher -> RemoteIterableDataset shm:// path with recording."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from blendjax.native import ring as nring
+
+pytestmark = pytest.mark.skipif(
+    not nring.native_available(), reason="native ring not built (no g++?)"
+)
+
+
+def _addr(tag):
+    return f"shm://bjx-test-{tag}-{os.getpid()}"
+
+
+def test_roundtrip_and_order():
+    w = nring.ShmRingWriter(_addr("rt"), capacity_bytes=1 << 16)
+    r = nring.ShmRingReader(_addr("rt"))
+    try:
+        for i in range(10):
+            assert w.send_frames([f"msg{i}".encode(), b"x" * i])
+        for i in range(10):
+            frames = r.recv_frames(timeout_ms=1000)
+            assert frames == [f"msg{i}".encode(), b"x" * i]
+        assert r.recv_frames(timeout_ms=0) is None
+    finally:
+        w.close()
+        r.close()
+
+
+def test_wraparound_many_messages():
+    # ring much smaller than total traffic -> exercises the wrap marker
+    w = nring.ShmRingWriter(_addr("wrap"), capacity_bytes=1 << 14)  # 16 KiB
+    r = nring.ShmRingReader(_addr("wrap"))
+    payload = os.urandom(1000)
+    n = 200
+    errors = []
+
+    def produce():
+        for i in range(n):
+            if not w.send_frames([i.to_bytes(4, "little"), payload], timeout_ms=5000):
+                errors.append(i)
+                return
+
+    t = threading.Thread(target=produce)
+    t.start()
+    try:
+        for i in range(n):
+            frames = r.recv_frames(timeout_ms=5000)
+            assert frames is not None, f"timeout at {i}"
+            assert int.from_bytes(frames[0], "little") == i
+            assert frames[1] == payload
+    finally:
+        t.join()
+        w.close()
+        r.close()
+    assert not errors
+
+
+def test_backpressure_blocks_writer():
+    w = nring.ShmRingWriter(_addr("bp"), capacity_bytes=1 << 12)  # 4 KiB
+    r = nring.ShmRingReader(_addr("bp"))
+    try:
+        big = b"z" * 1500
+        assert w.send_frames([big], timeout_ms=200)
+        assert w.send_frames([big], timeout_ms=200)
+        # ring full now: bounded wait then False
+        assert not w.send_frames([big], timeout_ms=200)
+        # drain one -> space again
+        assert r.recv_frames(timeout_ms=1000) is not None
+        assert w.send_frames([big], timeout_ms=2000)
+    finally:
+        w.close()
+        r.close()
+
+
+def test_oversize_message_raises():
+    w = nring.ShmRingWriter(_addr("big"), capacity_bytes=1 << 12)
+    try:
+        with pytest.raises(ValueError, match="larger than ring"):
+            w.send_frames([b"x" * (1 << 13)])
+    finally:
+        w.close()
+
+
+def test_eof_after_producer_close():
+    w = nring.ShmRingWriter(_addr("eof"), capacity_bytes=1 << 14)
+    r = nring.ShmRingReader(_addr("eof"))
+    w.send_frames([b"last"])
+    w.close(unlink=False)
+    assert r.recv_frames(timeout_ms=1000) == [b"last"]
+    with pytest.raises(EOFError):
+        r.recv_frames(timeout_ms=1000)
+    r.close()
+
+
+def test_publisher_dataset_shm_end_to_end(tmp_path):
+    from blendjax.btb.publisher import DataPublisher
+    from blendjax.btt.dataset import FileDataset, RemoteIterableDataset
+
+    addrs = [_addr("e2e-0"), _addr("e2e-1")]
+    stop = threading.Event()
+
+    def produce(addr, btid):
+        pub = DataPublisher(addr, btid=btid, raw_buffers=True, sndtimeoms=200)
+        i = 0
+        while not stop.is_set() and i < 64:
+            img = np.full((8, 8, 3), (btid * 10 + i) % 255, np.uint8)
+            if pub.publish(image=img, frameid=i):
+                i += 1
+        pub.close()
+
+    threads = [
+        threading.Thread(target=produce, args=(a, i), daemon=True)
+        for i, a in enumerate(addrs)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        prefix = str(tmp_path / "shmrec")
+        ds = RemoteIterableDataset(addrs, max_items=16, timeoutms=10000)
+        ds.enable_recording(prefix)
+        items = list(ds.stream(worker_id=0, num_workers=2))  # rings split
+        assert len(items) == 8
+        assert all(i["btid"] == 0 for i in items)  # worker 0 owns ring 0
+        assert items[0]["image"].shape == (8, 8, 3)
+        # recording worked through the shm path too
+        replay = FileDataset(prefix)
+        assert len(replay) == 8
+        np.testing.assert_array_equal(replay[0]["image"], items[0]["image"])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_shm_timeout():
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    w = nring.ShmRingWriter(_addr("idle"), capacity_bytes=1 << 12)
+    try:
+        ds = RemoteIterableDataset([_addr("idle")], max_items=1, timeoutms=300)
+        with pytest.raises(TimeoutError):
+            list(ds)
+    finally:
+        w.close()
+
+
+def test_launcher_shm_addresses():
+    from blendjax.btt.launcher import BlenderLauncher
+
+    bl = BlenderLauncher.__new__(BlenderLauncher)
+    bl.bind_addr = "127.0.0.1"
+    bl.proto = "shm"
+    bl.start_port = 13000
+    bl.num_instances = 2
+    bl.named_sockets = ["DATA"]
+    assert bl._addresses()["DATA"] == [
+        "shm://blendjax-DATA-13000",
+        "shm://blendjax-DATA-13001",
+    ]
