@@ -40,10 +40,13 @@ def free_port():
     return port
 
 
-def launch_producers(n, raw, width, height):
+def launch_producers(n, raw, width, height, transport="tcp"):
     addrs, procs = [], []
     for i in range(n):
-        addr = f"tcp://127.0.0.1:{free_port()}"
+        if transport == "shm":
+            addr = f"shm://bjx-bench-{os.getpid()}-{i}"
+        else:
+            addr = f"tcp://127.0.0.1:{free_port()}"
         cmd = [
             sys.executable,
             PRODUCER,
@@ -74,7 +77,9 @@ def run(args):
     from blendjax.btt.prefetch import JaxStream
     from blendjax.ops.image import decode_frames
 
-    addrs, procs = launch_producers(args.instances, args.raw, args.width, args.height)
+    addrs, procs = launch_producers(
+        args.instances, args.raw, args.width, args.height, transport=args.transport
+    )
     try:
         ds = RemoteIterableDataset(
             addrs, max_items=args.items, timeoutms=60000, queue_size=args.queue
@@ -153,6 +158,11 @@ def run(args):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if args.transport == "shm":
+            from blendjax.native import unlink_address
+
+            for a in addrs:
+                unlink_address(a)
 
 
 def parse_args(argv=None):
@@ -166,6 +176,13 @@ def parse_args(argv=None):
     ap.add_argument("--height", type=int, default=480)
     ap.add_argument("--channels", type=int, default=4)
     ap.add_argument("--warmup-batches", type=int, default=8)
+    ap.add_argument(
+        "--transport",
+        choices=["tcp", "shm"],
+        default="tcp",
+        help="shm = native shared-memory rings (workers partition rings; "
+        "use workers == instances)",
+    )
     ap.add_argument("--raw", action="store_true", default=True,
                     help="zero-copy wire encoding (blendjax native)")
     ap.add_argument("--pickle", dest="raw", action="store_false",

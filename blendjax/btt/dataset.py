@@ -181,7 +181,9 @@ class RemoteIterableDataset:
         mine = self.addresses[worker_id::num_workers]
         if not mine:
             return
-        readers = [ShmRingReader(a) for a in mine]
+        # ring creation waits on producer startup: give it the stream timeout
+        open_ms = max(self.timeoutms, 10000)
+        readers = [ShmRingReader(a, open_timeout_ms=open_ms) for a in mine]
         count = self.max_items // (num_workers * num_shards)
         try:
             with ExitStack() as es:
@@ -198,18 +200,24 @@ class RemoteIterableDataset:
                     )
                 delivered = 0
                 waited_ms = 0
-                slice_ms = 20
+                # single ring (the common case: one worker per producer):
+                # block inside the C call, 100 us wakeups.  Multi-ring:
+                # non-blocking rotation with a short host-side sleep.
+                block_ms = 100 if len(readers) == 1 else 0
                 while delivered < count and readers:
                     progressed = False
                     for reader in list(readers):
                         if stop_event is not None and stop_event.is_set():
                             return
                         try:
-                            frames = reader.recv_frames(timeout_ms=0)
+                            frames = reader.recv_frames(timeout_ms=block_ms)
                         except EOFError:
+                            reader.close(unlink=True)  # drained + closed
                             readers.remove(reader)
+                            block_ms = 100 if len(readers) == 1 else 0
                             continue
                         if frames is None:
+                            waited_ms += max(block_ms, 0)
                             continue
                         progressed = True
                         waited_ms = 0
@@ -220,8 +228,9 @@ class RemoteIterableDataset:
                         if delivered >= count:
                             return
                     if not progressed:
-                        time.sleep(slice_ms / 1000.0)
-                        waited_ms += slice_ms
+                        if block_ms == 0:
+                            time.sleep(0.001)
+                            waited_ms += 1
                         if waited_ms >= self.timeoutms:
                             raise TimeoutError(
                                 f"No message within {self.timeoutms} ms from {mine}"

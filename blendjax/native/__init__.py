@@ -7,4 +7,5 @@ from blendjax.native.ring import (  # noqa: F401
     is_shm_address,
     native_available,
     shm_name_from_address,
+    unlink_address,
 )

@@ -60,6 +60,14 @@ def _load():
             ctypes.c_uint64,
             ctypes.c_int,
         ]
+        lib.bjr_write_v.restype = ctypes.c_int
+        lib.bjr_write_v.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint32,
+            ctypes.c_int,
+        ]
         lib.bjr_read_acquire.restype = ctypes.c_int
         lib.bjr_read_acquire.argtypes = [
             ctypes.c_void_p,
@@ -88,23 +96,37 @@ def shm_name_from_address(address: str) -> str:
     return name if name.startswith("/") else "/" + name
 
 
-def _pack_frames(frames) -> bytes:
-    parts = [struct.pack("<I", len(frames))]
-    for f in frames:
-        b = bytes(f)
-        parts.append(struct.pack("<Q", len(b)))
-        parts.append(b)
-    return b"".join(parts)
+def _frame_ptr_len(obj):
+    """(pointer, nbytes, keepalive) for a frame without copying.
+
+    numpy arrays expose their data pointer directly; bytes via c_char_p.
+    Anything else is materialized to bytes once.
+    """
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        return arr.ctypes.data, arr.nbytes, arr
+    if not isinstance(obj, (bytes, bytearray)):
+        obj = bytes(obj)
+    buf = (ctypes.c_char * len(obj)).from_buffer_copy(obj) if isinstance(
+        obj, bytearray
+    ) else obj
+    if isinstance(buf, bytes):
+        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value
+        return ptr, len(buf), buf
+    return ctypes.addressof(buf), len(obj), buf
 
 
 def _unpack_frames(buf: memoryview):
+    """Parse a record written by ``bjr_write_v``:
+    u32 nframes | u64 len[n] | payloads."""
     (nframes,) = struct.unpack_from("<I", buf, 0)
-    off = 4
+    lens = struct.unpack_from(f"<{nframes}Q", buf, 4)
+    off = 4 + 8 * nframes
     frames = []
-    for _ in range(nframes):
-        (ln,) = struct.unpack_from("<Q", buf, off)
-        off += 8
-        frames.append(bytes(buf[off : off + ln]))  # copy out of shm
+    for ln in lens:
+        frames.append(bytes(buf[off : off + ln]))  # the one copy out of shm
         off += ln
     return frames
 
@@ -125,9 +147,23 @@ class ShmRingWriter:
             raise OSError(f"failed to create shm ring {name}")
 
     def send_frames(self, frames, timeout_ms=-1) -> bool:
-        """Write one framed message; False on timeout (backpressure)."""
-        payload = _pack_frames(frames)
-        rc = self._lib.bjr_write(self._h, payload, len(payload), timeout_ms)
+        """Write one framed message; False on timeout (backpressure).
+
+        Scatter-gather: each frame (numpy array or bytes) is memcpy'd once,
+        directly into the shm arena by ``bjr_write_v`` with the GIL
+        released — no Python-side join.
+        """
+        n = len(frames)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        keep = []
+        for i, f in enumerate(frames):
+            ptr, ln, alive = _frame_ptr_len(f)
+            ptrs[i] = ptr
+            lens[i] = ln
+            keep.append(alive)
+        rc = self._lib.bjr_write_v(self._h, ptrs, lens, n, timeout_ms)
+        del keep
         if rc == -2:
             raise ValueError("message larger than ring capacity")
         return rc == 0
@@ -179,7 +215,16 @@ class ShmRingReader:
     def pending_bytes(self):
         return self._lib.bjr_pending(self._h)
 
-    def close(self):
+    def close(self, unlink=False):
         if self._h:
-            self._lib.bjr_close(self._h, 0)
+            self._lib.bjr_close(self._h, int(unlink))
             self._h = None
+
+
+def unlink_address(address):
+    """Best-effort removal of a ring's shm backing file."""
+    name = shm_name_from_address(address).lstrip("/")
+    try:
+        os.unlink(os.path.join("/dev/shm", name))
+    except OSError:
+        pass

@@ -146,39 +146,81 @@ void* bjr_open(const char* name, int timeout_ms) {
   return h;
 }
 
-// Write one record.  Blocks (bounded backpressure) until space or timeout.
-// Returns 0 ok, -1 timeout, -2 message larger than ring.
-int bjr_write(void* handle, const void* data, uint64_t len, int timeout_ms) {
-  auto* h = static_cast<Handle*>(handle);
+namespace {
+
+// Claim `need` contiguous bytes (record payload + 8-byte length prefix
+// already included by the caller).  Returns the write position, or
+// UINT64_MAX on timeout.  Handles the wrap marker.
+uint64_t claim(Handle* h, uint64_t need, int timeout_ms) {
   Header* hdr = h->hdr;
   const uint64_t cap = hdr->capacity;
-  const uint64_t need = 8 + pad8(len);
-  if (need + 8 > cap) return -2;  // +8: wrap marker headroom
-
   uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms < 0 ? 0 : timeout_ms);
   uint64_t head = hdr->head.load(std::memory_order_relaxed);
-
   for (;;) {
     uint64_t tail = hdr->tail.load(std::memory_order_acquire);
     uint64_t pos = head % cap;
     uint64_t to_end = cap - pos;
-    // wrap cost if the record cannot sit contiguously before the end
     uint64_t total = (to_end < need) ? to_end + need : need;
     if (cap - (head - tail) >= total) {
       if (to_end < need) {
-        // wrap marker, then restart at arena begin
         std::memcpy(h->arena + pos, &kWrapMarker, 8);
         head += to_end;
+        hdr->head.store(head, std::memory_order_release);
         pos = 0;
       }
-      std::memcpy(h->arena + pos, &len, 8);
-      std::memcpy(h->arena + pos + 8, data, len);
-      hdr->head.store(head + 8 + pad8(len), std::memory_order_release);
-      return 0;
+      return pos;
     }
-    if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    if (timeout_ms >= 0 && now_ms() >= deadline) return ~0ULL;
     sleep_us(100);
   }
+}
+
+}  // namespace
+
+// Write one record.  Blocks (bounded backpressure) until space or timeout.
+// Returns 0 ok, -1 timeout, -2 message larger than ring.
+int bjr_write(void* handle, const void* data, uint64_t len, int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  const uint64_t cap = h->hdr->capacity;
+  const uint64_t need = 8 + pad8(len);
+  if (need + 8 > cap) return -2;  // +8: wrap marker headroom
+  uint64_t pos = claim(h, need, timeout_ms);
+  if (pos == ~0ULL) return -1;
+  std::memcpy(h->arena + pos, &len, 8);
+  std::memcpy(h->arena + pos + 8, data, len);
+  h->hdr->head.fetch_add(need, std::memory_order_release);
+  return 0;
+}
+
+// Scatter-gather write: one framed record assembled directly in the ring
+// (no caller-side join).  Record payload layout:
+//   u32 nframes | u64 len[nframes] | frame bytes (concatenated)
+// This is the hot path for the Python bindings: numpy frame payloads are
+// memcpy'd exactly once, from their own buffers into shm, with the GIL
+// released (ctypes foreign call).
+int bjr_write_v(void* handle, const void* const* bufs, const uint64_t* lens,
+                uint32_t nbufs, int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  const uint64_t cap = h->hdr->capacity;
+  uint64_t payload = 4 + 8ULL * nbufs;
+  for (uint32_t i = 0; i < nbufs; ++i) payload += lens[i];
+  const uint64_t need = 8 + pad8(payload);
+  if (need + 8 > cap) return -2;
+  uint64_t pos = claim(h, need, timeout_ms);
+  if (pos == ~0ULL) return -1;
+  uint8_t* p = h->arena + pos;
+  std::memcpy(p, &payload, 8);
+  p += 8;
+  std::memcpy(p, &nbufs, 4);
+  p += 4;
+  std::memcpy(p, lens, 8ULL * nbufs);
+  p += 8ULL * nbufs;
+  for (uint32_t i = 0; i < nbufs; ++i) {
+    std::memcpy(p, bufs[i], lens[i]);
+    p += lens[i];
+  }
+  h->hdr->head.fetch_add(need, std::memory_order_release);
+  return 0;
 }
 
 // Acquire the next record without copying.  *data points into the shm
