@@ -28,6 +28,7 @@ _LAZY = {
     "launch_env": ("blendjax.btt.env", "launch_env"),
     "OpenAIRemoteEnv": ("blendjax.btt.env", "OpenAIRemoteEnv"),
     "EnvPool": ("blendjax.btt.envpool", "EnvPool"),
+    "FleetWatchdog": ("blendjax.btt.watchdog", "FleetWatchdog"),
     "get_primary_ip": ("blendjax.btt.utils", "get_primary_ip"),
 }
 
@@ -44,6 +45,8 @@ _LAZY_MODULES = (
     "env",
     "envpool",
     "env_rendering",
+    "watchdog",
+    "torch_compat",
     "utils",
     "constants",
     "apps",

@@ -33,6 +33,18 @@ from blendjax.btt.utils import get_primary_ip
 logger = logging.getLogger("blendjax")
 
 
+def popen_group_kwargs():
+    """Popen kwargs isolating the child in its own process group, so fleet
+    teardown can signal whole process trees without touching the caller's
+    group (fixes the reference's dead-variable bug, ``launcher.py:124-132``,
+    and is shared with the watchdog's respawn path)."""
+    if os.name == "posix":
+        return {"preexec_fn": os.setsid}
+    if os.name == "nt":
+        return {"creationflags": subprocess.CREATE_NEW_PROCESS_GROUP}
+    return {}
+
+
 class BlenderLauncher:
     """Context manager launching and tearing down Blender instances.
 
@@ -157,12 +169,7 @@ class BlenderLauncher:
             seed = int(np.random.randint(np.iinfo(np.int32).max - self.num_instances))
         seeds = [seed + i for i in range(self.num_instances)]
 
-        if os.name == "posix":
-            popen_kwargs = {"preexec_fn": os.setsid}
-        elif os.name == "nt":
-            popen_kwargs = {"creationflags": subprocess.CREATE_NEW_PROCESS_GROUP}
-        else:
-            popen_kwargs = {}
+        popen_kwargs = popen_group_kwargs()
 
         env = os.environ.copy()
         processes, commands = [], []
@@ -225,7 +232,7 @@ class BlenderLauncher:
         if p.poll() is not None:
             return
         try:
-            if os.name == "posix":
+            if os.name == "posix" and os.getpgid(p.pid) != os.getpgrp():
                 os.killpg(os.getpgid(p.pid), _signal.SIGTERM)
             else:
                 p.terminate()
@@ -236,7 +243,7 @@ class BlenderLauncher:
         except subprocess.TimeoutExpired:
             logger.warning("Instance pid=%d ignored SIGTERM; killing.", p.pid)
             try:
-                if os.name == "posix":
+                if os.name == "posix" and os.getpgid(p.pid) != os.getpgrp():
                     os.killpg(os.getpgid(p.pid), _signal.SIGKILL)
                 else:
                     p.kill()

@@ -1,0 +1,106 @@
+"""Fleet failure detection.
+
+The reference detects producer death only when the user polls
+``BlenderLauncher.assert_alive`` or when the stream times out
+(``launcher.py:166-171``, ``dataset.py:98-99`` — SURVEY.md §5: "No restart,
+no elasticity").  ``FleetWatchdog`` watches the fleet from a background
+thread and reports deaths promptly; with ``restart=True`` it respawns dead
+instances with their original command line — streams reconnect
+transparently because producers bind and consumers keep their connect-mode
+sockets (tcp transport).
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+import threading
+import time
+
+logger = logging.getLogger("blendjax")
+
+
+class FleetWatchdog:
+    """Monitors a launched fleet.
+
+    Params
+    ------
+    launcher: BlenderLauncher
+        A launcher inside its context (``launch_info`` populated).
+    interval: float
+        Poll period, seconds.
+    on_death: callable | None
+        ``on_death(index, exit_code)`` invoked per death (from the watchdog
+        thread).
+    restart: bool
+        Respawn dead instances with their original command.
+    """
+
+    def __init__(self, launcher, interval=1.0, on_death=None, restart=False):
+        self.launcher = launcher
+        self.interval = interval
+        self.on_death = on_death
+        self.restart = restart
+        self.deaths = []  # (index, exit_code, restarted)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("watchdog already started")
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def alive(self):
+        """Number of currently-running instances."""
+        info = self.launcher.launch_info
+        if info is None or info.processes is None:
+            return 0
+        return sum(1 for p in info.processes if p.poll() is None)
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            info = self.launcher.launch_info
+            if info is None or info.processes is None:
+                return
+            for idx, proc in enumerate(info.processes):
+                code = proc.poll()
+                if code is None:
+                    continue
+                already = any(d[0] == idx and not d[2] for d in self.deaths)
+                restarted = False
+                if self.restart:
+                    from blendjax.btt.launcher import popen_group_kwargs
+
+                    new = subprocess.Popen(
+                        shlex.split(info.commands[idx]), **popen_group_kwargs()
+                    )
+                    info.processes[idx] = new
+                    restarted = True
+                    logger.warning(
+                        "instance %d died (exit %s); restarted as pid %d",
+                        idx, code, new.pid,
+                    )
+                elif not already:
+                    logger.warning("instance %d died (exit %s)", idx, code)
+                else:
+                    continue
+                self.deaths.append((idx, code, restarted))
+                if self.on_death is not None:
+                    self.on_death(idx, code)

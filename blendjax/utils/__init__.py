@@ -1,5 +1,18 @@
-"""Cross-cutting utilities (stage timing / duty-cycle observability)."""
+"""Cross-cutting utilities: stage timing / duty-cycle observability and
+train-state checkpointing."""
 
+from blendjax.utils.checkpoint import (
+    load_pytree,
+    load_train_state,
+    save_pytree,
+    save_train_state,
+)
 from blendjax.utils.timing import StageTimer
 
-__all__ = ["StageTimer"]
+__all__ = [
+    "StageTimer",
+    "save_pytree",
+    "load_pytree",
+    "save_train_state",
+    "load_train_state",
+]

@@ -1,0 +1,68 @@
+"""Train-state checkpointing.
+
+The reference has no model checkpointing (its checkpoint/resume analog is
+stream record/replay, SURVEY.md §5 — blendjax keeps that in
+``btt.FileRecorder``/``FileDataset``).  This module adds the model-state
+half: save/restore arbitrary jax pytrees (params, optimizer state,
+``TrainState``) to a single ``.npz``.
+
+Leaves are stored by flattening order, which is deterministic for a fixed
+pytree structure; ``load_pytree`` restores into a target pytree of the same
+structure (shape/dtype checked).  No orbax dependency: nothing here is
+sharding-aware — for multi-host sharded states, gather or use orbax; for
+every blendjax workload (replicated or host-local states) this is enough
+and has zero API churn.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+def save_pytree(path, tree):
+    """Serialize a pytree of arrays to ``path`` (.npz, atomic rename)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_pytree(path, target):
+    """Restore arrays into the structure of ``target``.
+
+    ``target`` supplies the treedef (e.g. a freshly-initialized TrainState);
+    leaf count, shapes, and dtypes must match the checkpoint.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(target)
+    with np.load(path) as data:
+        if len(data.files) != len(leaves):
+            raise ValueError(
+                f"checkpoint has {len(data.files)} leaves, target expects "
+                f"{len(leaves)}"
+            )
+        loaded = []
+        for i, ref in enumerate(leaves):
+            arr = data[f"leaf_{i}"]
+            ref_arr = np.asarray(ref)
+            if arr.shape != ref_arr.shape:
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {arr.shape} != target "
+                    f"{ref_arr.shape}"
+                )
+            loaded.append(arr.astype(ref_arr.dtype))
+    return jax.tree_util.tree_unflatten(treedef, loaded)
+
+
+def save_train_state(path, state):
+    """Persist a :class:`blendjax.models.train.TrainState`."""
+    save_pytree(path, state)
+
+
+def load_train_state(path, template_state):
+    """Restore a TrainState into ``template_state``'s structure."""
+    return load_pytree(path, template_state)

@@ -146,3 +146,52 @@ def test_pool_action_count_mismatch(fake_blender):
     pool._needs_reset = np.zeros(2, bool)
     with pytest.raises(ValueError, match="expected 2 actions"):
         pool.step([1.0])
+
+
+def test_remote_controlled_agent_real_time_nonblocking():
+    """real_time=True: with no pending request the agent must not block the
+    frame loop (returns CMD_STEP, None); requests are served when present
+    (reference behavior ``btb/env.py:220-233,251-252``)."""
+    import types
+
+    import zmq
+
+    from blendjax import wire
+    from blendjax.btb.env import BaseEnv, RemoteControlledAgent
+    from helpers.producers import free_port
+
+    addr = f"tcp://127.0.0.1:{free_port()}"
+    agent = RemoteControlledAgent(addr, real_time=True, timeoutms=2000)
+    ctx = zmq.Context.instance()
+    req = ctx.socket(zmq.REQ)
+    req.setsockopt(zmq.LINGER, 0)
+    req.setsockopt(zmq.RCVTIMEO, 5000)
+    req.connect(addr)
+    env = types.SimpleNamespace(state=BaseEnv.STATE_RUN)
+    try:
+        # no request pending -> simulation continues without action
+        assert agent(env, obs=0.0, done=False) == (BaseEnv.CMD_STEP, None)
+        assert agent(env, obs=0.0, done=False) == (BaseEnv.CMD_STEP, None)
+
+        # a pending step request is consumed
+        wire.send_message(req, {"cmd": "step", "action": 3.5})
+        import time
+
+        time.sleep(0.2)  # let the request arrive
+        cmd, action = agent(env, obs=0.0, done=False)
+        assert cmd == BaseEnv.CMD_STEP and action == 3.5
+
+        # next frame: the reply (previous ctx) goes out even in real time
+        cmd, action = agent(env, obs=3.5, reward=1.0, done=False, time=7)
+        assert (cmd, action) == (BaseEnv.CMD_STEP, None)
+        reply = wire.recv_message(req)
+        assert reply["obs"] == 3.5 and reply["time"] == 7
+
+        # reset request while running -> CMD_RESTART
+        wire.send_message(req, {"cmd": "reset"})
+        time.sleep(0.2)
+        cmd, action = agent(env, obs=3.5, done=False)
+        assert cmd == BaseEnv.CMD_RESTART and action is None
+    finally:
+        agent.close()
+        req.close(0)

@@ -1,0 +1,75 @@
+"""Fleet watchdog tests: prompt death detection, single report per death,
+and restart-with-original-command elasticity (net-new vs the reference's
+poll-only assert_alive, SURVEY.md §5)."""
+
+import time
+
+import pytest
+import zmq
+
+from blendjax import wire
+from blendjax.btt.launcher import BlenderLauncher
+from blendjax.btt.watchdog import FleetWatchdog
+from helpers import BLEND_SCRIPTS, FAKE_BLENDER
+
+
+@pytest.fixture
+def fake_blender(monkeypatch):
+    monkeypatch.setenv("BLENDJAX_BLENDER", FAKE_BLENDER)
+
+
+def _drain(addresses, n, timeoutms=30000):
+    ctx = zmq.Context()
+    try:
+        sock = ctx.socket(zmq.PULL)
+        for a in addresses:
+            sock.connect(a)
+        out = []
+        for _ in range(n):
+            assert sock.poll(timeoutms)
+            out.append(wire.recv_message(sock))
+        return out
+    finally:
+        ctx.destroy(linger=0)
+
+
+def test_detects_death_once(fake_blender):
+    deaths = []
+    with BlenderLauncher(
+        scene="",
+        script=f"{BLEND_SCRIPTS}/exit.blend.py",
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12600,
+        background=True,
+    ) as bl:
+        with FleetWatchdog(
+            bl, interval=0.2, on_death=lambda i, c: deaths.append((i, c))
+        ) as wd:
+            _drain(bl.launch_info.addresses["DATA"], 1)
+            bl.wait()  # producer publishes once then exits
+            deadline = time.time() + 10
+            while not deaths and time.time() < deadline:
+                time.sleep(0.1)
+            assert deaths and deaths[0][0] == 0
+            time.sleep(0.6)  # more polls must not duplicate the report
+            assert len(deaths) == 1
+            assert wd.alive == 0
+
+
+def test_restart_respawns_instance(fake_blender):
+    with BlenderLauncher(
+        scene="",
+        script=f"{BLEND_SCRIPTS}/exit.blend.py",
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12650,
+        background=True,
+    ) as bl:
+        with FleetWatchdog(bl, interval=0.2, restart=True) as wd:
+            _drain(bl.launch_info.addresses["DATA"], 1)
+            # instance exits; watchdog must respawn it, and the respawned
+            # one publishes again on the same (re-bound) address
+            msgs = _drain(bl.launch_info.addresses["DATA"], 1)
+            assert msgs[0]["btid"] == 0
+            assert wd.deaths and wd.deaths[0][2] is True
