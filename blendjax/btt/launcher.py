@@ -22,7 +22,6 @@ import logging
 import os
 import signal as _signal
 import subprocess
-import sys
 
 import numpy as np
 

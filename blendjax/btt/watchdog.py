@@ -16,7 +16,6 @@ import logging
 import shlex
 import subprocess
 import threading
-import time
 
 logger = logging.getLogger("blendjax")
 

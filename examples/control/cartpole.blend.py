@@ -14,6 +14,15 @@ import bpy
 from blendjax import btb
 
 
+def _override_op(op, obj, **kwargs):
+    """Blender-version-safe operator call with an object override."""
+    if hasattr(bpy.context, "temp_override"):
+        with bpy.context.temp_override(object=obj, active_object=obj):
+            op(**kwargs)
+    else:
+        op({"object": obj}, **kwargs)
+
+
 def build_scene():
     for o in list(bpy.data.objects):
         bpy.data.objects.remove(o, do_unlink=True)
@@ -21,18 +30,18 @@ def build_scene():
     bpy.ops.mesh.primitive_cube_add(size=1.0, location=(0, 0, 0.5))
     cart = bpy.context.active_object
     cart.name = "Cart"
-    bpy.ops.rigidbody.object_add({"object": cart})
+    _override_op(bpy.ops.rigidbody.object_add, cart)
     cart.rigid_body.kinematic = True
 
     bpy.ops.mesh.primitive_cube_add(size=0.2, location=(0, 0, 2.0))
     pole = bpy.context.active_object
     pole.name = "Pole"
     pole.scale = (0.1, 0.1, 1.0)
-    bpy.ops.rigidbody.object_add({"object": pole})
+    _override_op(bpy.ops.rigidbody.object_add, pole)
 
     bpy.ops.object.empty_add(location=(0, 0, 1.0))
     pivot = bpy.context.active_object
-    bpy.ops.rigidbody.constraint_add({"object": pivot})
+    _override_op(bpy.ops.rigidbody.constraint_add, pivot)
     pivot.rigid_body_constraint.type = "HINGE"
     pivot.rigid_body_constraint.object1 = cart
     pivot.rigid_body_constraint.object2 = pole

@@ -16,20 +16,30 @@ from blendjax import btb
 NUM_CUBES = 8
 
 
+def add_rigidbody(obj):
+    """Blender-version-safe rigid-body add (3.2+ temp_override vs legacy
+    context-dict overrides)."""
+    if hasattr(bpy.context, "temp_override"):
+        with bpy.context.temp_override(object=obj, active_object=obj):
+            bpy.ops.rigidbody.object_add()
+    else:
+        bpy.ops.rigidbody.object_add({"object": obj})
+
+
 def build_scene(rng):
     for obj in list(bpy.data.objects):
         bpy.data.objects.remove(obj, do_unlink=True)
 
     bpy.ops.mesh.primitive_plane_add(size=20.0, location=(0, 0, 0))
     plane = bpy.context.active_object
-    bpy.ops.rigidbody.object_add({"object": plane})
+    add_rigidbody(plane)
     plane.rigid_body.type = "PASSIVE"
 
     cubes = []
     for _ in range(NUM_CUBES):
         bpy.ops.mesh.primitive_cube_add(size=1.0)
         cube = bpy.context.active_object
-        bpy.ops.rigidbody.object_add({"object": cube})
+        add_rigidbody(cube)
         mat = bpy.data.materials.new(name="rand")
         mat.diffuse_color = (*rng.uniform(0.1, 1.0, size=3), 1.0)
         cube.data.materials.append(mat)

@@ -5,7 +5,7 @@ adds scripted-agent unit tests of BaseEnv ordering and EnvPool coverage)."""
 import numpy as np
 import pytest
 
-from blendjax.btt.env import RemoteEnv, kwargs_to_cli, launch_env
+from blendjax.btt.env import kwargs_to_cli, launch_env
 from blendjax.btt.envpool import EnvPool, launch_env_pool
 from helpers import BLEND_SCRIPTS, FAKE_BLENDER, fake_bpy
 

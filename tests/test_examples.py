@@ -6,7 +6,6 @@ These cover the consumer-side logic of all three reference example families
 
 import jax
 import numpy as np
-import pytest
 
 from blendjax.btt.dataset import RemoteIterableDataset
 from blendjax.btt.prefetch import JaxStream
