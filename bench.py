@@ -41,13 +41,21 @@ def main():
     from benchmarks.benchmark import parse_args, run
 
     args = parse_args(
-        ["--instances", "4", "--workers", "4", "--batch", "8", "--items", "512"]
+        [
+            "--instances", "4",
+            "--workers", "4",
+            "--batch", "8",
+            "--items", "100000000",   # stream until the window closes
+            "--seconds", "45",         # fixed measurement window
+            "--warmup-deadline", "420",  # tunnel compiles can be slow
+        ]
     )
     result = run(args)
+    suffix = "stream_only" if result.get("train_degraded") else "stream_to_train"
     print(
         json.dumps(
             {
-                "metric": "cube640x480_images_per_sec_stream_to_train",
+                "metric": f"cube640x480_images_per_sec_{suffix}",
                 "value": round(result["images_per_sec"], 2),
                 "unit": "images/sec",
                 "vs_baseline": round(

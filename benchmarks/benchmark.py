@@ -121,32 +121,56 @@ def run(args):
             prefetch=2,
         )
 
+        # Two stopping modes: fixed item count (args.items drives stream
+        # length, reference-style) or a measurement window (--seconds) that
+        # bounds wall-clock regardless of device speed — essential when the
+        # first compile/H2D over a TPU tunnel is slow.  Warmup additionally
+        # has its own deadline: if the train step cannot warm up in time,
+        # the benchmark degrades to stream-only rather than never finishing.
         n_batches = 0
+        measured = 0
         t0 = None
         step_time = 0.0
-        for batch in stream:
-            if train_step is not None:
-                ts = time.perf_counter()
-                state, loss = train_step(state, batch)
-                jax.block_until_ready(loss)
-                step_time += time.perf_counter() - ts
-            else:
-                jax.block_until_ready(batch["image"])
-            n_batches += 1
-            if n_batches == args.warmup_batches:
-                t0 = time.perf_counter()  # discard warmup incl. compile
-                step_time = 0.0
+        warmup_deadline = time.perf_counter() + args.warmup_deadline
+        train_alive = train_step is not None
+        it = iter(stream)
+        try:
+            for batch in it:
+                if train_alive:
+                    ts = time.perf_counter()
+                    state, loss = train_step(state, batch)
+                    jax.block_until_ready(loss)
+                    step_time += time.perf_counter() - ts
+                else:
+                    jax.block_until_ready(batch["image"])
+                n_batches += 1
+                if t0 is None:
+                    warm = n_batches >= args.warmup_batches
+                    overdue = time.perf_counter() > warmup_deadline
+                    if overdue and train_alive:
+                        train_alive = False  # degrade: measure the feed only
+                    if warm or overdue:
+                        t0 = time.perf_counter()
+                        step_time = 0.0
+                    continue
+                measured += 1
+                if args.seconds and time.perf_counter() - t0 >= args.seconds:
+                    break
+        finally:
+            it.close()  # unwinds the prefetch thread promptly
+            stream.close()
+        if t0 is None or measured == 0:
+            raise RuntimeError("benchmark produced no measured batches")
         elapsed = time.perf_counter() - t0
-        measured = n_batches - args.warmup_batches
         images = measured * args.batch
 
-        sec_img = elapsed / images
         stats = stream.timer.summary()
         return {
             "images_per_sec": images / elapsed,
-            "sec_per_image": sec_img,
+            "sec_per_image": elapsed / images,
             "sec_per_batch": elapsed / measured,
-            "train_duty_cycle": (step_time / elapsed) if train_step else None,
+            "train_duty_cycle": (step_time / elapsed) if train_alive else None,
+            "train_degraded": bool(train_step is not None and not train_alive),
             "stages": stats,
             "batches": measured,
         }
@@ -176,6 +200,19 @@ def parse_args(argv=None):
     ap.add_argument("--height", type=int, default=480)
     ap.add_argument("--channels", type=int, default=4)
     ap.add_argument("--warmup-batches", type=int, default=8)
+    ap.add_argument(
+        "--seconds",
+        type=float,
+        default=0.0,
+        help="measure for a fixed window instead of exhausting --items",
+    )
+    ap.add_argument(
+        "--warmup-deadline",
+        type=float,
+        default=300.0,
+        help="max seconds to spend warming up (compiles); past it the "
+        "train step is dropped and the feed alone is measured",
+    )
     ap.add_argument(
         "--transport",
         choices=["tcp", "shm"],
