@@ -35,6 +35,14 @@ def put_batch(batch, sharding=None):
     """
     if sharding is None:
         return jax.device_put(batch)
+    num = getattr(sharding, "num_devices", None) or len(sharding.device_set)
+    leaf = next(iter(jax.tree.leaves(batch)), None)
+    if leaf is not None and hasattr(leaf, "shape") and leaf.shape[0] % num:
+        raise ValueError(
+            f"batch dim {leaf.shape[0]} not divisible by the sharding's "
+            f"{num} devices; pick batch_size as a multiple of the mesh's "
+            f"data axis"
+        )
     if jax.process_count() > 1:
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(

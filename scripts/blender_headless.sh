@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Wrapper for running Blender with Eevee offscreen rendering on headless
+# hosts (TPU-VMs): Eevee needs a GL context, which `--background` alone
+# does not provide (reference Readme.md:98, SURVEY.md §7 "Blender on
+# TPU-VMs").  Point $BLENDJAX_BLENDER at this script and blendjax's
+# launcher/finder will treat it as the Blender executable:
+#
+#   export BLENDJAX_BLENDER=/path/to/blendjax/scripts/blender_headless.sh
+#
+# Prefers a virtual X server (xvfb-run, software GL via mesa/llvmpipe,
+# works everywhere); falls back to plain blender if xvfb is absent and a
+# display exists.
+set -euo pipefail
+
+BLENDER_BIN="${BLENDJAX_REAL_BLENDER:-blender}"
+
+if command -v xvfb-run >/dev/null 2>&1 && [ -z "${DISPLAY:-}" ]; then
+    exec xvfb-run --auto-servernum \
+        --server-args="-screen 0 1280x1024x24 +extension GLX +render" \
+        "$BLENDER_BIN" "$@"
+fi
+exec "$BLENDER_BIN" "$@"

@@ -91,3 +91,9 @@ def test_jax_stream_end_to_end():
     stats = stream.timer.summary()
     assert {"recv", "collate", "device_put"} <= set(stats)
     assert stats["device_put"]["count"] == 4
+
+
+def test_put_batch_indivisible_raises():
+    mesh = data_mesh()
+    with pytest.raises(ValueError, match="not divisible"):
+        put_batch({"x": np.zeros((6, 2), np.float32)}, data_sharding(mesh))
