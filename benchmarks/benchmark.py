@@ -220,6 +220,11 @@ def parse_args(argv=None):
         help="shm = native shared-memory rings (workers partition rings; "
         "use workers == instances)",
     )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="print the driver's one-line JSON result instead of the report",
+    )
     ap.add_argument("--raw", action="store_true", default=True,
                     help="zero-copy wire encoding (blendjax native)")
     ap.add_argument("--pickle", dest="raw", action="store_false",
@@ -232,6 +237,24 @@ def parse_args(argv=None):
 if __name__ == "__main__":
     args = parse_args()
     result = run(args)
+    if args.json:
+        import json
+
+        suffix = (
+            "stream_only" if result.get("train_degraded") else "stream_to_train"
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"cube640x480_images_per_sec_{suffix}",
+                    "value": round(result["images_per_sec"], 2),
+                    "unit": "images/sec",
+                    "vs_baseline": round(result["images_per_sec"] * 0.012, 3),
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(0)
     print(f"images/sec      : {result['images_per_sec']:.1f}")
     print(f"sec/image       : {result['sec_per_image']:.5f}")
     print(f"sec/batch({args.batch})    : {result['sec_per_batch']:.5f}")
