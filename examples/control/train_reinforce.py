@@ -36,25 +36,62 @@ def train(
     gamma=0.99,
     key=None,
     log_every=5,
+    mesh=None,
 ):
     """Rollout `horizon` steps across the pool per iteration, then one
-    REINFORCE update.  Returns (state, per-iteration mean returns)."""
+    REINFORCE update.  Returns (state, per-iteration mean returns).
+
+    With ``mesh`` the update runs SPMD over the mesh's ``data`` axis:
+    rollout transitions shard ``P('data')``, the policy replicates, and XLA
+    inserts the gradient psum — the modern jax.sharding form of the
+    reference-era "train the policy under pmap" (BASELINE.md north star).
+    ``horizon * num_envs`` must divide the data-axis size.
+    """
     key = key if key is not None else jax.random.PRNGKey(0)
     params = policy.init(jax.random.PRNGKey(1), obs_dim, num_actions)
     opt = optax.adam(lr)
-    state = TrainState.create(params, opt)
 
-    @jax.jit
-    def update(state, obs, actions, returns):
-        def loss_fn(p):
-            return policy.reinforce_loss(p, obs, actions, returns)
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        updates, opt_state = opt.update(grads, state.opt_state, state.params)
-        return (
-            TrainState(optax.apply_updates(state.params, updates), opt_state, state.step + 1),
-            loss,
+    def batch_loss(p, batch):
+        return policy.reinforce_loss(
+            p, batch["obs"], batch["actions"], batch["returns"]
         )
+
+    data_sharding = None
+    if mesh is not None:
+        from blendjax.parallel import data_sharding as make_data_sharding
+        from blendjax.parallel import make_sharded_train_step
+
+        data_sharding = make_data_sharding(mesh)
+        init_sharded, sharded_step = make_sharded_train_step(
+            batch_loss, opt, mesh, rules={}
+        )
+        state = init_sharded(params)
+
+        def update(state, obs, actions, returns):
+            batch = jax.device_put(
+                {"obs": obs, "actions": actions, "returns": returns},
+                data_sharding,
+            )
+            return sharded_step(state, batch)
+
+    else:
+        state = TrainState.create(params, opt)
+
+        @jax.jit
+        def _step(state, batch):
+            loss, grads = jax.value_and_grad(batch_loss)(state.params, batch)
+            updates, opt_state = opt.update(grads, state.opt_state, state.params)
+            return (
+                TrainState(
+                    optax.apply_updates(state.params, updates),
+                    opt_state,
+                    state.step + 1,
+                ),
+                loss,
+            )
+
+        def update(state, obs, actions, returns):
+            return _step(state, {"obs": obs, "actions": actions, "returns": returns})
 
     sample = jax.jit(policy.sample_action)
 

@@ -168,3 +168,25 @@ def test_gym_package_import_without_gym():
         import cartpole_gym  # noqa: F401
     finally:
         sys.path.pop(0)
+
+
+def test_reinforce_spmd_over_mesh():
+    """Policy update sharded over the 8-device data axis produces finite
+    losses and keeps the policy replicated."""
+    from blendjax.parallel import data_mesh
+
+    tr = load_example("control/train_reinforce.py")
+    pool = _NumpyCartpolePool(8, seed=1)
+    state, returns = tr.train(
+        pool,
+        iterations=3,
+        horizon=48,  # 48*8 transitions, divisible by the 8-way mesh
+        key=jax.random.PRNGKey(2),
+        log_every=0,
+        mesh=data_mesh(),
+    )
+    assert len(returns) == 3 and np.isfinite(returns).all()
+    from jax.sharding import PartitionSpec as P
+
+    leaf = jax.tree.leaves(state.params)[0]
+    assert leaf.sharding.spec == P()  # replicated policy
