@@ -9,6 +9,10 @@ processes.  Each worker thread runs its own PULL socket via
 ``RemoteIterableDataset.stream(worker_id, num_workers)`` — identical fan-in
 semantics, zero inter-process copies.
 
+Batches are assembled *inside* the worker threads (torch DataLoader
+semantics: each worker emits whole batches), which parallelizes collation
+across workers and puts one queue element per batch instead of per item.
+
 Multi-host TPU slices pass ``shard=(process_index, process_count)`` so the
 global stream is split hosts × workers (SURVEY.md §2.4).
 """
@@ -62,23 +66,25 @@ class BatchLoader:
         self.shard = shard
         self.drop_last = drop_last
         self.timer = StageTimer()
-        self._queue = queue.Queue(maxsize=max(2, prefetch_batches) * batch_size)
+        self._queue = queue.Queue(maxsize=max(2, prefetch_batches))
         self._stop = threading.Event()
         self._threads = []
         self._started = False
 
     def __len__(self):
-        shard_id, num_shards = self.shard
+        _, num_shards = self.shard
         per_worker = self.dataset.max_items // (self.num_workers * num_shards)
-        total = per_worker * self.num_workers
-        n, rem = divmod(total, self.batch_size)
-        return n if (self.drop_last or rem == 0) else n + 1
+        n, rem = divmod(per_worker, self.batch_size)
+        if not self.drop_last and rem:
+            n += 1
+        return n * self.num_workers
 
     # -- worker machinery ---------------------------------------------------
 
     def _worker(self, worker_id):
         shard_id, num_shards = self.shard
         try:
+            batch = []
             for item in self.dataset.stream(
                 worker_id=worker_id,
                 num_workers=self.num_workers,
@@ -86,9 +92,17 @@ class BatchLoader:
                 num_shards=num_shards,
                 stop_event=self._stop,
             ):
-                self._queue.put(item)
+                batch.append(item)
+                if len(batch) == self.batch_size:
+                    with self.timer.stage("collate"):
+                        out = self.collate_fn(batch)
+                    batch = []
+                    self._queue.put(out)
                 if self._stop.is_set():
                     return
+            if batch and not self.drop_last:
+                with self.timer.stage("collate"):
+                    self._queue.put(self.collate_fn(batch))
             self._queue.put(_SENTINEL)
         except BaseException as exc:  # propagate to the consumer thread
             self._queue.put(exc)
@@ -131,7 +145,6 @@ class BatchLoader:
             )
         self._start()
         finished = 0
-        batch = []
         try:
             while finished < self.num_workers:
                 with self.timer.stage("recv"):
@@ -141,14 +154,6 @@ class BatchLoader:
                     continue
                 if isinstance(item, BaseException):
                     raise item
-                batch.append(item)
-                if len(batch) == self.batch_size:
-                    with self.timer.stage("collate"):
-                        out = self.collate_fn(batch)
-                    batch = []
-                    yield out
-            if batch and not self.drop_last:
-                with self.timer.stage("collate"):
-                    yield self.collate_fn(batch)
+                yield item
         finally:
             self.close()

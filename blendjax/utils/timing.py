@@ -15,21 +15,25 @@ Usage::
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 
 
 class StageTimer:
-    """Accumulates wall-clock time per named stage."""
+    """Accumulates wall-clock time per named stage (thread-safe: stages are
+    recorded from loader workers and the prefetch thread concurrently)."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self):
-        self._total = defaultdict(float)
-        self._count = defaultdict(int)
-        self._start = time.perf_counter()
+        with getattr(self, "_lock", threading.Lock()):
+            self._total = defaultdict(float)
+            self._count = defaultdict(int)
+            self._start = time.perf_counter()
 
     @contextmanager
     def stage(self, name):
@@ -37,13 +41,12 @@ class StageTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self._total[name] += dt
-            self._count[name] += 1
+            self.add(name, time.perf_counter() - t0)
 
     def add(self, name, seconds):
-        self._total[name] += seconds
-        self._count[name] += 1
+        with self._lock:
+            self._total[name] += seconds
+            self._count[name] += 1
 
     @property
     def wall_s(self):
