@@ -17,7 +17,8 @@ from contextlib import nullcontext
 
 
 class LaunchInfo:
-    """Addresses, commands and (optionally) process handles of a launch."""
+    """Addresses, commands (argv lists, Popen-ready) and (optionally)
+    process handles of a launch."""
 
     def __init__(self, addresses, commands, processes=None):
         self.addresses = dict(addresses)

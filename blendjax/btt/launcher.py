@@ -44,6 +44,24 @@ def popen_group_kwargs():
     return {}
 
 
+def child_env():
+    """Environment for producer subprocesses.
+
+    ``--python-use-system-env`` tells Blender to honor PYTHONPATH; prepend the
+    package root that provides ``blendjax`` (the btb producer side) so
+    producer scripts can import it even when the launching process found it
+    via cwd alone.  Shared with the watchdog's respawn path.
+    """
+    env = os.environ.copy()
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
+    )
+    return env
+
+
 class BlenderLauncher:
     """Context manager launching and tearing down Blender instances.
 
@@ -170,7 +188,7 @@ class BlenderLauncher:
 
         popen_kwargs = popen_group_kwargs()
 
-        env = os.environ.copy()
+        env = child_env()
         processes, commands = [], []
         try:
             for idx in range(self.num_instances):
@@ -193,8 +211,8 @@ class BlenderLauncher:
 
                 p = subprocess.Popen(cmd, shell=False, env=env, **popen_kwargs)
                 processes.append(p)
-                commands.append(" ".join(cmd))
-                logger.info("Started instance %d: %s", idx, commands[-1])
+                commands.append(list(cmd))
+                logger.info("Started instance %d: %s", idx, " ".join(cmd))
         except Exception:
             for p in processes:
                 self._stop_process(p)

@@ -20,6 +20,7 @@ global stream is split hosts × workers (SURVEY.md §2.4).
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 
 from blendjax.btt.collate import collate as default_collate
@@ -71,6 +72,21 @@ class BatchLoader:
         self._threads = []
         self._started = False
 
+        # Batching happens per worker: a worker that never accumulates a full
+        # batch yields nothing under drop_last, which silently drops the whole
+        # stream when batch_size exceeds the per-worker item count.
+        max_items = getattr(dataset, "max_items", None)
+        if drop_last and max_items is not None:
+            per_worker = max_items // (num_workers * shard[1])
+            if per_worker < batch_size:
+                raise ValueError(
+                    f"batch_size={batch_size} exceeds the per-worker item "
+                    f"count {per_worker} ({max_items} items / {num_workers} "
+                    f"workers / {shard[1]} shards); every batch would be "
+                    "dropped. Lower batch_size/num_workers or pass "
+                    "drop_last=False."
+                )
+
     def __len__(self):
         _, num_shards = self.shard
         per_worker = self.dataset.max_items // (self.num_workers * num_shards)
@@ -80,6 +96,17 @@ class BatchLoader:
         return n * self.num_workers
 
     # -- worker machinery ---------------------------------------------------
+
+    def _put(self, item):
+        """Blocking put that aborts when the loader is being closed, so
+        workers can never deadlock on a full queue nobody drains."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self, worker_id):
         shard_id, num_shards = self.shard
@@ -97,15 +124,18 @@ class BatchLoader:
                     with self.timer.stage("collate"):
                         out = self.collate_fn(batch)
                     batch = []
-                    self._queue.put(out)
+                    if not self._put(out):
+                        return
                 if self._stop.is_set():
                     return
             if batch and not self.drop_last:
                 with self.timer.stage("collate"):
-                    self._queue.put(self.collate_fn(batch))
-            self._queue.put(_SENTINEL)
+                    out = self.collate_fn(batch)
+                if not self._put(out):
+                    return
+            self._put(_SENTINEL)
         except BaseException as exc:  # propagate to the consumer thread
-            self._queue.put(exc)
+            self._put(exc)
 
     def _start(self):
         self._started = True
@@ -119,6 +149,11 @@ class BatchLoader:
     def close(self):
         """Stop worker threads promptly (idempotent)."""
         self._stop.set()
+        if sys.is_finalizing():
+            # close() can run from generator finalization during interpreter
+            # shutdown (abandoned iterator): the queue module is already torn
+            # down and the daemon workers are dead — nothing to drain or join.
+            return
         # drain so blocked put() calls can observe the stop flag
         try:
             while True:
@@ -127,7 +162,8 @@ class BatchLoader:
             pass
         for t in self._threads:
             t.join(timeout=5)
-        self._threads = []
+        # keep hung workers visible instead of masking a leak
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def __enter__(self):
         return self
@@ -147,8 +183,17 @@ class BatchLoader:
         finished = 0
         try:
             while finished < self.num_workers:
+                # timed get so a cross-thread close() (which stops workers
+                # before their sentinels land) can't strand this consumer
                 with self.timer.stage("recv"):
-                    item = self._queue.get()
+                    while True:
+                        if self._stop.is_set():
+                            return
+                        try:
+                            item = self._queue.get(timeout=0.1)
+                            break
+                        except queue.Empty:
+                            continue
                 if item is _SENTINEL:
                     finished += 1
                     continue

@@ -13,7 +13,6 @@ sockets (tcp transport).
 from __future__ import annotations
 
 import logging
-import shlex
 import subprocess
 import threading
 
@@ -85,10 +84,12 @@ class FleetWatchdog:
                 already = any(d[0] == idx and not d[2] for d in self.deaths)
                 restarted = False
                 if self.restart:
-                    from blendjax.btt.launcher import popen_group_kwargs
+                    from blendjax.btt.launcher import child_env, popen_group_kwargs
 
                     new = subprocess.Popen(
-                        shlex.split(info.commands[idx]), **popen_group_kwargs()
+                        info.commands[idx],
+                        env=child_env(),
+                        **popen_group_kwargs(),
                     )
                     info.processes[idx] = new
                     restarted = True

@@ -30,7 +30,7 @@ class StageTimer:
         self.reset()
 
     def reset(self):
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             self._total = defaultdict(float)
             self._count = defaultdict(int)
             self._start = time.perf_counter()
@@ -53,26 +53,33 @@ class StageTimer:
         return time.perf_counter() - self._start
 
     def total_s(self, name):
-        return self._total[name]
+        with self._lock:
+            return self._total.get(name, 0.0)
 
     def count(self, name):
-        return self._count[name]
+        with self._lock:
+            return self._count.get(name, 0)
 
     def mean_ms(self, name):
-        c = self._count[name]
-        return (self._total[name] / c) * 1e3 if c else 0.0
+        with self._lock:
+            c = self._count.get(name, 0)
+            return (self._total[name] / c) * 1e3 if c else 0.0
 
     def duty_cycle(self, name):
         """Fraction of wall time since reset spent inside ``name``."""
         wall = self.wall_s
-        return self._total[name] / wall if wall > 0 else 0.0
+        with self._lock:
+            return self._total.get(name, 0.0) / wall if wall > 0 else 0.0
 
     def summary(self):
-        return {
-            name: {
-                "count": self._count[name],
-                "total_s": round(self._total[name], 6),
-                "mean_ms": round(self.mean_ms(name), 3),
+        with self._lock:
+            return {
+                name: {
+                    "count": self._count[name],
+                    "total_s": round(total, 6),
+                    "mean_ms": round((total / self._count[name]) * 1e3, 3)
+                    if self._count[name]
+                    else 0.0,
+                }
+                for name, total in self._total.items()
             }
-            for name in self._total
-        }

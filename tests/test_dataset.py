@@ -102,6 +102,58 @@ def test_loader_single_use():
             iter(loader).__next__()
 
 
+def test_loader_rejects_undersized_per_worker_batches():
+    # 16 items / 4 workers = 4 per worker < batch_size 8: with drop_last every
+    # worker would silently discard its whole stream, so construction fails.
+    ds = RemoteIterableDataset([f"tcp://127.0.0.1:{free_port()}"], max_items=16)
+    with pytest.raises(ValueError, match="per-worker"):
+        BatchLoader(ds, batch_size=8, num_workers=4)
+    # drop_last=False keeps partial batches, so the same config is legal
+    BatchLoader(ds, batch_size=8, num_workers=4, drop_last=False)
+
+
+def test_loader_early_close_does_not_hang():
+    # Consumer abandons the iterator mid-stream: close() must unblock workers
+    # stuck on a full queue (sentinel/tail puts) without the 5s join timeout.
+    import time
+
+    with ProducerFleet(num_producers=1) as fleet:
+        ds = RemoteIterableDataset(fleet.addresses, max_items=32)
+        loader = BatchLoader(ds, batch_size=2, num_workers=4, prefetch_batches=2)
+        it = iter(loader)
+        next(it)  # start workers, take one batch, then walk away
+        t0 = time.monotonic()
+        loader.close()
+        assert time.monotonic() - t0 < 4
+        assert not loader._threads
+
+
+def test_loader_cross_thread_close_unblocks_consumer():
+    # JaxStream iterates the loader from a prefetch thread; close() from the
+    # main thread must unblock a consumer stuck in queue.get() even though
+    # stopped workers never deliver their sentinels.
+    import threading
+    import time
+
+    dead = f"tcp://127.0.0.1:{free_port()}"
+    ds = RemoteIterableDataset([dead], max_items=64, timeoutms=30000)
+    loader = BatchLoader(ds, batch_size=2, num_workers=2)
+    done = threading.Event()
+
+    def consume():
+        for _ in loader:  # blocks: producer address is dead
+            pass
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)  # let the consumer block in queue.get()
+    loader.close()
+    assert done.wait(timeout=4), "consumer stayed blocked after close()"
+    t.join(timeout=2)
+    assert not t.is_alive()
+
+
 def test_collate_nested():
     items = [
         {"a": np.ones((2, 2)), "b": (1.0, np.zeros(3)), "s": "x", "flag": True},
