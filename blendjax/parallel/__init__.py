@@ -1,6 +1,12 @@
 """Mesh construction, sharding rules, and SPMD train-step builders."""
 
 from blendjax.parallel.mesh import data_mesh, data_sharding, make_mesh, replicated
+from blendjax.parallel.ring_attention import (
+    full_attention,
+    make_ring_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from blendjax.parallel.sharding import (
     detector_rules,
     make_sharded_train_step,
@@ -17,4 +23,8 @@ __all__ = [
     "make_sharded_train_step",
     "param_specs",
     "shard_pytree",
+    "full_attention",
+    "make_ring_attention",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,167 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence models at all (SURVEY.md §5 "long-context:
+absent"), but blendjax treats long-context as first-class: episodes
+streamed out of Blender are *sequences* (frames, observations, actions),
+and temporal models over long episodes need the sequence dimension sharded
+across chips.  Two standard TPU-native schemes, both pure-JAX collectives
+over the ICI mesh:
+
+- **Ring attention** (:func:`ring_attention`): every device holds one
+  contiguous sequence shard of Q, K and V.  K/V blocks rotate around the
+  ring with ``lax.ppermute`` while each device accumulates its queries'
+  attention over every block using an online (flash-style) softmax, so
+  memory stays O(S/n) per device and the permute overlaps with the block
+  matmul.  Exact — not an approximation.
+- **Ulysses** (:func:`ulysses_attention`): ``lax.all_to_all`` reshards
+  [seq-sharded, all heads] -> [all seq, head-sharded], runs ordinary full
+  attention per head group, and reshards back.  Cheaper collectives for
+  moderate sequence lengths; requires ``heads % axis_size == 0``.
+
+Both run *inside* ``shard_map`` (the functions take an ``axis_name``);
+:func:`make_ring_attention` wraps one up to act on globally-sharded arrays.
+Causal masking uses global positions reconstructed from
+``lax.axis_index``, so results match single-device attention bit-for-bit
+in structure (small float differences only from blockwise accumulation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+_NEG = -1e30  # finite mask value: keeps the online-softmax nan-free
+
+
+def _pvary(x, axes):
+    """Mark ``x`` device-varying over ``axes`` under shard_map's vma typing
+    (no-op on JAX versions without the typing)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
+
+
+def full_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
+    """Plain softmax attention; the single-device reference implementation.
+
+    q: (B, Sq, H, D), k/v: (B, Sk, H, D).  ``*_offset`` give the global
+    position of element 0 along the sequence axis (used by the parallel
+    schemes for causal masking across shards).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = kpos[None, :] <= qpos[:, None]
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None):
+    """Exact blockwise attention over a ring of sequence shards.
+
+    Call inside ``shard_map``: q/k/v are the *local* shards
+    (B, S/n, H, D) of arrays sharded ``P(None, axis_name, None, None)``.
+    Returns the local shard of the attention output.
+    """
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(jnp.float32)
+
+    qf = q.astype(jnp.float32)
+    # Receive from the next device: after t rotations we hold block (me + t) % n.
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    qpos = me * s_loc + jnp.arange(s_loc)
+
+    def accumulate(o, m, l, kb, vb, blk):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            kpos = blk * s_loc + jnp.arange(s_loc)
+            mask = kpos[None, :] <= qpos[:, None]
+            scores = jnp.where(mask[None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+        return o * corr[..., None] + pv, m_new, l
+
+    def body(carry, t):
+        o, m, l, kb, vb = carry
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        o, m, l = accumulate(o, m, l, kb, vb, (me + t) % n)
+        return (o, m, l, kb, vb), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    # Constant-initialized carries are "unvarying" under shard_map's vma
+    # typing while the loop body makes them device-varying; align the types
+    # over every axis the inputs vary over (seq + optional batch axis).
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    o0, m0, l0 = (_pvary(x, axes) for x in (o0, m0, l0))
+    # Own block first (no rotation), then n-1 rotate-and-accumulate steps.
+    o, m, l = accumulate(o0, m0, l0, k, v, me)
+    (o, _, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(1, n))
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
+
+    Call inside ``shard_map`` with local shards (B, S/n, H, D); requires
+    ``H % n == 0`` (enforced by ``all_to_all``).  Reshards seq->heads,
+    attends over the full sequence for the local head group, reshards back.
+    """
+    # (B, S/n, H, D) -> (B, S, H/n, D)
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = full_attention(qh, kh, vh, causal=causal, scale=scale)
+    # back to (B, S/n, H, D)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_ring_attention(mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None):
+    """Wrap :func:`ring_attention` / :func:`ulysses_attention` for global
+    arrays sharded ``P(batch_axis, seq_axis, None, None)`` over ``mesh``.
+
+    Returns ``attn(q, k, v) -> out`` usable directly under ``jax.jit`` —
+    composes with data parallelism by passing ``batch_axis='data'``.
+    """
+    spec = P(batch_axis, seq_axis, None, None)
+    if impl == "ring":
+        vary = tuple(a for a in (batch_axis, seq_axis) if a is not None)
+        inner = functools.partial(
+            ring_attention, axis_name=seq_axis, causal=causal, vary_axes=vary
+        )
+    elif impl == "ulysses":
+        inner = functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal)
+    else:
+        raise ValueError(f"unknown impl {impl!r} (want 'ring' or 'ulysses')")
+    mapped = shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+
+    def attn(q, k, v):
+        sh = NamedSharding(mesh, spec)
+        q, k, v = (lax.with_sharding_constraint(x, sh) for x in (q, k, v))
+        return mapped(q, k, v)
+
+    return attn

@@ -9,6 +9,16 @@ behind the ``blender`` / ``tpu`` markers.
 import os
 import sys
 
+# Child processes (fake Blender fleet, producer subprocesses) resolve
+# `python3` via their shebang/PATH; make sure they find the interpreter
+# running pytest (which has the deps) rather than a bare system python.
+import shutil
+
+_bindir = os.path.dirname(os.path.abspath(sys.executable))
+_resolved = shutil.which("python3")
+if _resolved is None or os.path.dirname(os.path.abspath(_resolved)) != _bindir:
+    os.environ["PATH"] = _bindir + os.pathsep + os.environ.get("PATH", "")
+
 # Force, don't setdefault: the ambient env pins JAX_PLATFORMS to the real
 # TPU tunnel, which must never be touched from unit tests.
 os.environ["JAX_PLATFORMS"] = "cpu"
