@@ -4,10 +4,21 @@
 - ``discriminator`` — real/fake image scorer for densityopt.
 - ``probmodel``     — log-normal sim-parameter model + score-function grads.
 - ``policy``        — MLP policies + REINFORCE for the control workload.
+- ``seqformer``     — causal temporal transformer (world-model) over
+                      episode sequences; long-context flagship (ring/
+                      Ulysses sequence parallel, optional MoE).
 - ``train``         — TrainState + jitted/donated train-step builders.
 """
 
-from blendjax.models import detector, discriminator, layers, policy, probmodel, train
+from blendjax.models import (
+    detector,
+    discriminator,
+    layers,
+    policy,
+    probmodel,
+    seqformer,
+    train,
+)
 from blendjax.models.train import TrainState, make_eval_step, make_train_step
 
 __all__ = [
@@ -16,6 +27,7 @@ __all__ = [
     "layers",
     "policy",
     "probmodel",
+    "seqformer",
     "train",
     "TrainState",
     "make_train_step",

@@ -1,0 +1,179 @@
+"""SeqFormer — temporal transformer over streamed Blender episodes.
+
+The reference has no sequence models (SURVEY.md §5: long-context "absent");
+blendjax's episodes — frames, observations, actions streamed out of
+Blender — are sequences, and this is the flagship long-context model over
+them: a causal transformer world-model that consumes an episode's
+observation sequence and predicts the next observation at every step
+(the standard self-supervised objective for learned simulators).
+
+TPU-first design decisions:
+
+- plain ``{name: array}`` pytrees (jit/shard/donate-clean, like every
+  blendjax model);
+- bfloat16 compute on the MXU, float32 params and softmax/layernorm
+  accumulation;
+- **pluggable attention**: ``apply(..., attn_fn=...)`` accepts any
+  ``(q, k, v) -> out`` — pass
+  :func:`blendjax.parallel.make_ring_attention` output to run the sequence
+  axis sharded over the mesh (ring or Ulysses), nothing to change in the
+  model;
+- optional **mixture-of-experts MLP** (``n_experts > 0``): a soft mixture
+  computed densely (every expert evaluated, gate-weighted sum) so shapes
+  stay static; expert weights stack on a leading axis that shards over an
+  ``'expert'`` mesh axis — XLA turns the gate-weighted contraction into a
+  psum over the expert shards (expert parallelism without ragged
+  dispatch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blendjax.models.layers import dense_apply, dense_init, gelu
+from blendjax.parallel.ring_attention import full_attention
+
+
+def _ln_init(d):
+    return {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}
+
+
+def _ln_apply(p, x):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (out * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _moe_init(key, n_experts, d, d_ff):
+    kg, k1, k2 = jax.random.split(key, 3)
+    s1 = jnp.sqrt(2.0 / d)
+    s2 = jnp.sqrt(2.0 / d_ff)
+    return {
+        "gate": dense_init(kg, d, n_experts),
+        "w1": jax.random.normal(k1, (n_experts, d, d_ff)) * s1,
+        "b1": jnp.zeros((n_experts, d_ff)),
+        "w2": jax.random.normal(k2, (n_experts, d_ff, d)) * s2,
+        "b2": jnp.zeros((n_experts, d)),
+    }
+
+
+def _moe_apply(p, x, dtype):
+    """Soft mixture over all experts (static shapes, expert-sharded psum)."""
+    gates = jax.nn.softmax(dense_apply(p["gate"], x, dtype=jnp.float32), axis=-1)
+    h = jnp.einsum("btd,edf->betf", x.astype(dtype), p["w1"].astype(dtype))
+    h = gelu(h + p["b1"][None, :, None, :].astype(dtype))
+    y = jnp.einsum("betf,efd->betd", h, p["w2"].astype(dtype))
+    y = y + p["b2"][None, :, None, :].astype(dtype)
+    return jnp.einsum("bte,betd->btd", gates.astype(dtype), y)
+
+
+def init(
+    key,
+    obs_dim=8,
+    d_model=64,
+    n_heads=4,
+    n_layers=2,
+    d_ff=None,
+    n_experts=0,
+    max_len=1024,
+):
+    """Initialize SeqFormer params.
+
+    ``n_experts=0`` gives a dense MLP; ``n_experts>0`` the MoE variant.
+    """
+    d_ff = d_ff or 4 * d_model
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
+    dh = d_model // n_heads
+    keys = jax.random.split(key, 3 + n_layers)
+    params = {
+        "embed": dense_init(keys[0], obs_dim, d_model),
+        "pos": jax.random.normal(keys[1], (max_len, d_model)) * 0.02,
+        "blocks": [],
+        "ln_f": _ln_init(d_model),
+        "head": dense_init(keys[2], d_model, obs_dim),
+    }
+    scale = jnp.sqrt(1.0 / d_model)
+    for i in range(n_layers):
+        ka, km = jax.random.split(keys[3 + i])
+        kq, kk, kv, ko = jax.random.split(ka, 4)
+        # Head-major projection layout (d, H, Dh)/(H, Dh, d): the head axis
+        # is a real array axis, so tensor parallelism shards it directly
+        # (seqformer_rules) and n_heads is recoverable from the shapes.
+        block = {
+            "ln1": _ln_init(d_model),
+            "wq": {"w": jax.random.normal(kq, (d_model, n_heads, dh)) * scale,
+                   "b": jnp.zeros((n_heads, dh))},
+            "wk": {"w": jax.random.normal(kk, (d_model, n_heads, dh)) * scale,
+                   "b": jnp.zeros((n_heads, dh))},
+            "wv": {"w": jax.random.normal(kv, (d_model, n_heads, dh)) * scale,
+                   "b": jnp.zeros((n_heads, dh))},
+            "wo": {"w": jax.random.normal(ko, (n_heads, dh, d_model)) * scale,
+                   "b": jnp.zeros((d_model,))},
+            "ln2": _ln_init(d_model),
+        }
+        if n_experts > 0:
+            block["moe"] = _moe_init(km, n_experts, d_model, d_ff)
+        else:
+            k1, k2 = jax.random.split(km)
+            block["mlp"] = {
+                "fc": dense_init(k1, d_model, d_ff),
+                "proj": dense_init(k2, d_ff, d_model),
+            }
+        params["blocks"].append(block)
+    return params
+
+
+def apply(params, obs, attn_fn=None, compute_dtype=jnp.bfloat16):
+    """Forward pass: (B, T, obs_dim) -> (B, T, obs_dim) next-obs prediction.
+
+    ``attn_fn(q, k, v) -> out`` with (B, T, H, Dh) tensors; defaults to
+    single-device causal :func:`full_attention`.  Pass a
+    ``make_ring_attention(mesh, causal=True, ...)`` closure to shard the
+    sequence axis.
+    """
+    if attn_fn is None:
+        def attn_fn(q, k, v):
+            return full_attention(q, k, v, causal=True)
+
+    b, t, _ = obs.shape
+    x = dense_apply(params["embed"], obs.astype(compute_dtype), dtype=compute_dtype)
+    x = x + params["pos"][:t].astype(compute_dtype)[None]
+    for blk in params["blocks"]:
+        h = _ln_apply(blk["ln1"], x)
+        q, k, v = (
+            jnp.einsum("btd,dhk->bthk", h, blk[n]["w"].astype(compute_dtype))
+            + blk[n]["b"].astype(compute_dtype)
+            for n in ("wq", "wk", "wv")
+        )
+        a = attn_fn(q, k, v)
+        o = jnp.einsum("bthk,hkd->btd", a, blk["wo"]["w"].astype(compute_dtype))
+        x = x + o + blk["wo"]["b"].astype(compute_dtype)
+        h = _ln_apply(blk["ln2"], x)
+        if "moe" in blk:
+            x = x + _moe_apply(blk["moe"], h, compute_dtype)
+        else:
+            h = gelu(dense_apply(blk["mlp"]["fc"], h, dtype=compute_dtype))
+            x = x + dense_apply(blk["mlp"]["proj"], h, dtype=compute_dtype)
+    x = _ln_apply(params["ln_f"], x)
+    return dense_apply(params["head"], x, dtype=jnp.float32)
+
+
+def loss_fn(params, batch, attn_fn=None, compute_dtype=jnp.bfloat16):
+    """MSE next-observation loss.
+
+    ``batch = {'obs': (B,T,D), 'target': (B,T,D)}`` — the target is the
+    obs sequence shifted host-side (so the device-side loss needs no
+    cross-shard shift when T is sequence-sharded).
+    """
+    pred = apply(params, batch["obs"], attn_fn=attn_fn, compute_dtype=compute_dtype)
+    err = pred - batch["target"].astype(jnp.float32)
+    return jnp.mean(err * err)
+
+
+def make_episode_batch(obs_seq):
+    """Host-side helper: episode array (B, T+1, D) -> {'obs', 'target'}."""
+    return {"obs": obs_seq[:, :-1], "target": obs_seq[:, 1:]}

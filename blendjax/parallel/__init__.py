@@ -1,6 +1,12 @@
 """Mesh construction, sharding rules, and SPMD train-step builders."""
 
 from blendjax.parallel.mesh import data_mesh, data_sharding, make_mesh, replicated
+from blendjax.parallel.pipeline import (
+    make_pipeline,
+    microbatch,
+    stack_stage_params,
+    unstack_stage_params,
+)
 from blendjax.parallel.ring_attention import (
     full_attention,
     make_ring_attention,
@@ -9,8 +15,10 @@ from blendjax.parallel.ring_attention import (
 )
 from blendjax.parallel.sharding import (
     detector_rules,
+    make_seqformer_train_step,
     make_sharded_train_step,
     param_specs,
+    seqformer_rules,
     shard_pytree,
 )
 
@@ -20,11 +28,17 @@ __all__ = [
     "make_mesh",
     "replicated",
     "detector_rules",
+    "seqformer_rules",
     "make_sharded_train_step",
+    "make_seqformer_train_step",
     "param_specs",
     "shard_pytree",
     "full_attention",
     "make_ring_attention",
     "ring_attention",
     "ulysses_attention",
+    "make_pipeline",
+    "microbatch",
+    "stack_stage_params",
+    "unstack_stage_params",
 ]

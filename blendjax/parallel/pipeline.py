@@ -1,0 +1,142 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference has no model parallelism of any kind (SURVEY.md §2.4);
+blendjax provides the full TPU-native set.  This module is the pipeline
+leg: the model is split into S stages whose parameters stack on a leading
+stage axis sharded ``P(pipe_axis)`` — one stage per device group — and
+microbatches flow stage-to-stage over ICI with ``lax.ppermute``, the
+idiomatic XLA/SPMD pipelining pattern (no send/recv primitives, no
+schedulers: one ``lax.scan`` over clock ticks, collectives inserted by
+XLA).
+
+Schedule: with M microbatches and S stages the scan runs M + S - 1 ticks;
+at tick t stage s works on microbatch t - s (bubble ticks compute values
+that are masked out of the collected output).  Reverse-mode AD through
+the scan + ppermute gives the backward schedule automatically.
+
+Usage::
+
+    stage_fn(stage_params, x) -> y            # one stage, same x/y shape
+    stacked = stack_stage_params([p0, p1, ...])   # leading stage axis
+    apply = make_pipeline(stage_fn, mesh, pipe_axis='pipe')
+    y = apply(stacked, x)                     # x: (M, mb, ...) microbatched
+
+Constraints: one stage per pipe-axis shard (stack size == axis size) and
+stage input/output shapes equal (they ride the same ppermute buffer).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from blendjax.parallel.ring_attention import _pvary
+
+
+def stack_stage_params(stage_params_list):
+    """Stack per-stage param pytrees on a new leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
+
+
+def unstack_stage_params(stacked, n_stages):
+    """Inverse of :func:`stack_stage_params`."""
+    return [jax.tree.map(lambda x, i=i: x[i], stacked) for i in range(n_stages)]
+
+
+def pipeline(stage_params, x, stage_fn, axis_name, vary_axes=None):
+    """Run the pipeline *inside* ``shard_map``.
+
+    ``stage_params``: this shard's stage params (leading stage axis of
+    local size 1, squeezed here).  ``x``: microbatched input (M, mb, ...)
+    replicated over the pipe axis.  Returns (M, mb, ...) final-stage
+    outputs, replicated over the pipe axis via a masked psum.
+    """
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], stage_params)  # drop stage axis
+    m = x.shape[0]
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    # Stage s receives stage s-1's output.
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def tick(carry, t):
+        acc, state = carry
+        # Stage 0 ingests microbatch t (clamped on bubble ticks); other
+        # stages ingest the neighbor's previous output.
+        mb = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, m - 1), keepdims=False)
+        inp = jnp.where(me == 0, _pvary(mb, (axis_name,)), state)
+        out = stage_fn(params, inp)
+        # The last stage finished microbatch t - (n - 1) this tick.
+        widx = t - (n - 1)
+        upd = lax.dynamic_update_index_in_dim(acc, out, jnp.maximum(widx, 0), 0)
+        acc = jnp.where((me == n - 1) & (widx >= 0), upd, acc)
+        state = lax.ppermute(out, axis_name, perm)
+        return (acc, state), None
+
+    acc0 = _pvary(jnp.zeros((m,) + x.shape[1:], x.dtype), axes)
+    state0 = _pvary(jnp.zeros(x.shape[1:], x.dtype), axes)
+    (acc, _), _ = lax.scan(tick, (acc0, state0), jnp.arange(m + n - 1))
+    # Only the last stage holds real outputs; mask and psum replicates the
+    # result across the pipe axis.
+    return lax.psum(jnp.where(me == n - 1, acc, 0), axis_name)
+
+
+def make_pipeline(stage_fn, mesh, pipe_axis="pipe", x_spec=None):
+    """Wrap :func:`pipeline` for globally-sharded stacked stage params.
+
+    ``x_spec``: PartitionSpec of the microbatched input *excluding* the
+    pipe axis (e.g. ``P(None, 'data')`` to keep the per-microbatch batch
+    dim data-sharded); defaults to fully replicated.  Returns
+    ``apply(stacked_params, x)`` usable under ``jax.jit``.
+    """
+    x_spec = x_spec if x_spec is not None else P()
+    n = mesh.shape[pipe_axis]
+    vary = (pipe_axis,) + tuple(
+        a for axes in x_spec if axes is not None
+        for a in ((axes,) if isinstance(axes, str) else axes)
+    )
+    inner = functools.partial(
+        pipeline, stage_fn=stage_fn, axis_name=pipe_axis, vary_axes=vary
+    )
+    mapped = shard_map(
+        inner, mesh=mesh, in_specs=(P(pipe_axis), x_spec), out_specs=x_spec
+    )
+
+    def apply(stacked_params, x):
+        n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+        if n_stages != n:
+            raise ValueError(
+                f"stacked params have {n_stages} stages but mesh axis "
+                f"{pipe_axis!r} has size {n} (need exactly one per shard)"
+            )
+        stacked_params = jax.tree.map(
+            lambda p: lax.with_sharding_constraint(
+                p, NamedSharding(mesh, P(pipe_axis))
+            ),
+            stacked_params,
+        )
+        return mapped(stacked_params, x)
+
+    return apply
+
+
+def microbatch(batch, num_microbatches):
+    """Host/device-side reshape (B, ...) -> (M, B/M, ...) for the pipeline."""
+    def split(x):
+        b = x.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch {b} not divisible by {num_microbatches} microbatches"
+            )
+        return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    return jax.tree.map(split, batch)

@@ -138,20 +138,30 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
-def make_ring_attention(mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None):
+def make_ring_attention(
+    mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None, head_axis=None
+):
     """Wrap :func:`ring_attention` / :func:`ulysses_attention` for global
-    arrays sharded ``P(batch_axis, seq_axis, None, None)`` over ``mesh``.
+    arrays sharded ``P(batch_axis, seq_axis, head_axis, None)`` over
+    ``mesh``.
 
-    Returns ``attn(q, k, v) -> out`` usable directly under ``jax.jit`` —
-    composes with data parallelism by passing ``batch_axis='data'``.
+    Returns ``attn(q, k, v) -> out`` usable directly under ``jax.jit``.
+    Composes with data parallelism (``batch_axis='data'``) and — ring only
+    — with head-sharded tensor parallelism (``head_axis='model'``): each
+    device then ring-rotates K/V for its head block, so sequence and
+    tensor parallelism stack.  Ulysses repurposes the head axis for its
+    all-to-all and cannot also shard it.
     """
-    spec = P(batch_axis, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, head_axis, None)
     if impl == "ring":
-        vary = tuple(a for a in (batch_axis, seq_axis) if a is not None)
+        vary = tuple(a for a in (batch_axis, seq_axis, head_axis) if a is not None)
         inner = functools.partial(
             ring_attention, axis_name=seq_axis, causal=causal, vary_axes=vary
         )
     elif impl == "ulysses":
+        if head_axis is not None:
+            raise ValueError("ulysses uses the head dim for its all-to-all; "
+                             "head_axis sharding is ring-only")
         inner = functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal)
     else:
         raise ValueError(f"unknown impl {impl!r} (want 'ring' or 'ulysses')")
