@@ -64,6 +64,15 @@ def host_only_fallback(seconds=10.0):
 
 def main():
     here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    # fastest transport available: native shared-memory rings + zero-copy
+    # raw-buffer framing; tcp+pickle only if the native lib can't build
+    try:
+        from blendjax.native import native_available
+
+        native = native_available()
+    except Exception:
+        native = False
     cmd = [
         sys.executable,
         os.path.join(here, "benchmarks", "benchmark.py"),
@@ -75,9 +84,23 @@ def main():
         "--warmup-deadline", "420",
         "--json",
     ]
+    if native:
+        # raw framing only pays off on shm (tcp multipart adds syscalls)
+        cmd += ["--raw", "--transport", "shm"]
+    # child needs blendjax importable; child_env() prepends the repo root
+    # without replacing PYTHONPATH, which may carry the TPU plugin
+    # registration (axon sitecustomize)
+    from blendjax.btt.launcher import child_env
+
+    env = child_env()
     try:
         out = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=CHILD_BUDGET_S, cwd=here
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=CHILD_BUDGET_S,
+            cwd=here,
+            env=env,
         )
         for line in reversed(out.stdout.strip().splitlines()):
             line = line.strip()

@@ -29,6 +29,11 @@ import numpy as np
 HERE = os.path.dirname(os.path.abspath(__file__))
 PRODUCER = os.path.join(HERE, "stream_producer.py")
 
+# runnable directly (python benchmarks/benchmark.py): sys.path[0] is
+# benchmarks/, so the package root one level up must be added by hand
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
 
 def free_port():
     import socket
@@ -41,6 +46,14 @@ def free_port():
 
 
 def launch_producers(n, raw, width, height, transport="tcp"):
+    # children must find blendjax without clobbering the existing
+    # PYTHONPATH (it may carry the TPU plugin registration, e.g. the
+    # axon tunnel's sitecustomize) — child_env() prepends the repo root
+    # and preserves the rest
+    from blendjax.btt.launcher import child_env
+
+    env = child_env()
+    env["JAX_PLATFORMS"] = "cpu"  # producers never touch the accelerator
     addrs, procs = [], []
     for i in range(n):
         if transport == "shm":
@@ -57,17 +70,20 @@ def launch_producers(n, raw, width, height, transport="tcp"):
         ]
         if raw:
             cmd.append("--raw")
-        procs.append(subprocess.Popen(cmd))
+        procs.append(subprocess.Popen(cmd, env=env))
         addrs.append(addr)
     return addrs, procs
 
 
 def run(args):
-    # honor $JAX_PLATFORMS even when sitecustomize pre-registers a backend
+    # honor $JAX_PLATFORMS even when sitecustomize pre-registers a backend.
+    # Only force the config when it actually disagrees with the env var:
+    # re-setting it can break plugin platforms (e.g. the axon TPU tunnel)
+    # whose name is resolved during env-var handling at first init only.
     plat = os.environ.get("JAX_PLATFORMS")
     import jax
 
-    if plat:
+    if plat and jax.config.jax_platforms not in (None, "", plat):
         try:
             jax.config.update("jax_platforms", plat)
         except Exception:

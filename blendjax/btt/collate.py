@@ -12,6 +12,26 @@ import numbers
 
 import numpy as np
 
+try:
+    from blendjax.native.ring import fast_stack as _fast_stack
+except Exception:  # pragma: no cover - native package unavailable
+    _fast_stack = None
+
+#: Leaves at or above this many bytes stack via the native GIL-released
+#: gather; below it, ctypes call overhead beats the copy cost.
+_NATIVE_STACK_MIN_BYTES = 64 * 1024
+
+
+def _stack(items):
+    first = items[0]
+    if (
+        _fast_stack is not None
+        and first.nbytes >= _NATIVE_STACK_MIN_BYTES
+        and all(it.dtype == first.dtype for it in items[1:])
+    ):
+        return _fast_stack(items)
+    return np.stack(items)  # handles mixed dtypes via upcast
+
 
 def collate(items):
     """Collate a non-empty list of samples into one batched pytree."""
@@ -27,7 +47,7 @@ def collate(items):
     if isinstance(elem, np.ndarray):
         if any(it.shape != elem.shape for it in items[1:]):
             return list(items)  # ragged: leave unstacked
-        return np.stack(items)
+        return _stack(items)
     if isinstance(elem, numbers.Number) and not isinstance(elem, bool):
         return np.asarray(items)
     if isinstance(elem, bool):

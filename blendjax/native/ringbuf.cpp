@@ -278,4 +278,17 @@ void bjr_close(void* handle, int unlink_shm) {
   delete h;
 }
 
+// Batch assembly: copy n equal-role source buffers back-to-back into dst.
+// ctypes releases the GIL for the duration of the call, so concurrent
+// loader workers collate truly in parallel (np.stack holds the GIL for the
+// whole copy, serializing every worker thread through one core).
+void bjr_gather(char* dst, const void* const* srcs, const uint64_t* lens,
+                uint64_t n) {
+  uint64_t off = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    memcpy(dst + off, srcs[i], lens[i]);
+    off += lens[i];
+  }
+}
+
 }  // extern "C"

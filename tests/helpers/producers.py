@@ -35,7 +35,14 @@ class ProducerFleet:
     a Blender fleet with ``num_episodes=-1``.
     """
 
-    def __init__(self, num_producers=1, num_items=None, shape=(16, 16, 3), raw_buffers=False):
+    def __init__(
+        self,
+        num_producers=1,
+        num_items=None,
+        shape=(16, 16, 3),
+        raw_buffers=False,
+        btid_base=0,
+    ):
         self.addresses = [
             f"tcp://127.0.0.1:{free_port()}" for _ in range(num_producers)
         ]
@@ -44,13 +51,15 @@ class ProducerFleet:
         self.raw_buffers = raw_buffers
         self._stop = threading.Event()
         self._threads = [
-            threading.Thread(target=self._run, args=(i,), daemon=True)
+            threading.Thread(
+                target=self._run, args=(i, btid_base + i), daemon=True
+            )
             for i in range(num_producers)
         ]
 
-    def _run(self, btid):
+    def _run(self, index, btid):
         pub = DataPublisher(
-            self.addresses[btid],
+            self.addresses[index],
             btid=btid,
             raw_buffers=self.raw_buffers,
             sndtimeoms=200,
@@ -66,13 +75,25 @@ class ProducerFleet:
         finally:
             pub.close()
 
-    def __enter__(self):
+    def start(self):
+        if getattr(self, "_started", False):
+            return self  # threads are single-shot; restart needs a new fleet
+        self._started = True
         for t in self._threads:
             t.start()
         return self
 
-    def __exit__(self, *exc):
+    def close(self):
+        """Stop all producer threads (idempotent) — usable mid-test for
+        crash injection."""
         self._stop.set()
         for t in self._threads:
-            t.join(timeout=10)
+            if t.is_alive():
+                t.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
         return False

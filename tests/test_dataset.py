@@ -85,6 +85,37 @@ def test_timeout_raises():
         list(ds)
 
 
+def test_producer_crash_midstream_survivor_keeps_feeding():
+    """Failure injection (a gap in the reference's suite, SURVEY.md §4):
+    one of two producers dies mid-stream; the fan-in keeps draining the
+    survivor and the consumer still reaches max_items."""
+    doomed = ProducerFleet(num_producers=1, btid_base=0)
+    survivor = ProducerFleet(num_producers=1, btid_base=1)
+    doomed.start()
+    survivor.start()
+    try:
+        ds = RemoteIterableDataset(
+            doomed.addresses + survivor.addresses, max_items=24, timeoutms=5000
+        )
+        it = ds.stream()
+        got = [next(it) for _ in range(4)]  # both producers known-live
+        doomed.close()  # crash injection
+        got += list(it)  # must complete from the survivor alone
+    finally:
+        doomed.close()
+        survivor.close()
+    assert len(got) == 24
+    # the survivor must still be *live* after the crash, not just drained
+    # from buffers: at most HWM(10)+HWM(10) doomed items can be in flight,
+    # so the tail is survivor traffic with frameids past the pre-crash mark
+    pre_crash_max = max(
+        (i["frameid"] for i in got[:4] if i["btid"] == 1), default=-1
+    )
+    tail_survivor = [i for i in got[-4:] if i["btid"] == 1]
+    assert tail_survivor, f"no survivor items in tail: {[i['btid'] for i in got[-4:]]}"
+    assert max(i["frameid"] for i in tail_survivor) > pre_crash_max
+
+
 def test_worker_error_propagates():
     dead = f"tcp://127.0.0.1:{free_port()}"
     ds = RemoteIterableDataset([dead], max_items=4, timeoutms=300)

@@ -165,3 +165,57 @@ def test_launcher_shm_addresses():
         "shm://blendjax-DATA-13000",
         "shm://blendjax-DATA-13001",
     ]
+
+
+def test_fast_stack_matches_np_stack():
+    rng = np.random.default_rng(0)
+    items = [rng.random((480, 640, 4)).astype(np.float32) for _ in range(8)]
+    np.testing.assert_array_equal(nring.fast_stack(items), np.stack(items))
+    # non-contiguous sources are handled via a contiguous copy
+    views = [a[:, ::2, :] for a in items]
+    np.testing.assert_array_equal(nring.fast_stack(views), np.stack(views))
+    # uint8 + preallocated out buffer
+    bytes_items = [rng.integers(0, 255, (64, 64, 3), dtype=np.uint8) for _ in range(4)]
+    out = np.empty((4, 64, 64, 3), np.uint8)
+    res = nring.fast_stack(bytes_items, out=out)
+    assert res is out
+    np.testing.assert_array_equal(out, np.stack(bytes_items))
+
+
+def test_fast_stack_rejects_mismatch():
+    with pytest.raises(ValueError):
+        nring.fast_stack([np.zeros((2, 2)), np.zeros((2, 3))])
+    with pytest.raises(ValueError):
+        nring.fast_stack([np.zeros((2, 2), np.float32), np.zeros((2, 2), np.float64)])
+
+
+def test_fast_stack_validates_out():
+    items = [np.zeros((64, 64), np.float32) for _ in range(4)]
+    with pytest.raises(ValueError):
+        nring.fast_stack(items, out=np.empty((2, 64, 64), np.float32))
+    with pytest.raises(ValueError):
+        nring.fast_stack(items, out=np.empty((4, 64, 64), np.float64))
+    with pytest.raises(ValueError):
+        nring.fast_stack(items, out=np.empty((4, 64, 128), np.float32)[:, :, ::2])
+
+
+def test_recv_frames_large_payload_buffer_semantics():
+    """Frames >= 64KiB come back as uint8 ndarrays (GIL-released copy-out);
+    they must decode identically to the bytes path."""
+    from blendjax import wire
+
+    addr = _addr("bigframe")
+    w = nring.ShmRingWriter(addr, capacity_bytes=8 << 20)
+    r = nring.ShmRingReader(addr)
+    try:
+        img = np.arange(512 * 512, dtype=np.uint8).reshape(512, 512)  # 256KB
+        frames_out = wire.encode({"image": img, "frameid": 3}, raw_buffers=True)
+        assert w.send_frames(frames_out, timeout_ms=1000)
+        frames_in = r.recv_frames(timeout_ms=1000)
+        assert isinstance(frames_in[1], np.ndarray)  # large payload
+        msg = wire.decode(frames_in)
+        np.testing.assert_array_equal(msg["image"], img)
+        assert msg["frameid"] == 3
+    finally:
+        r.close()
+        w.close(unlink=True)
