@@ -134,7 +134,7 @@ def run(args):
             batch_size=args.batch,
             num_workers=args.workers,
             transform=transform,
-            prefetch=2,
+            prefetch=args.prefetch,
         )
 
         # Two stopping modes: fixed item count (args.items drives stream
@@ -143,20 +143,39 @@ def run(args):
         # first compile/H2D over a TPU tunnel is slow.  Warmup additionally
         # has its own deadline: if the train step cannot warm up in time,
         # the benchmark degrades to stream-only rather than never finishing.
+        #
+        # Steps are dispatched asynchronously (XLA queues them); blocking on
+        # every step would insert a full host<->device round trip per batch,
+        # which over a tunneled TPU dominates the step itself.  A bounded
+        # in-flight window (--max-inflight) keeps dispatch ahead of
+        # execution without accumulating unbounded HBM: we block on the
+        # loss from K steps ago, not the latest.  --step-timing restores
+        # the blocking per-step mode and reports train_duty_cycle.
+        from collections import deque
+
         n_batches = 0
         measured = 0
         t0 = None
         step_time = 0.0
         warmup_deadline = time.perf_counter() + args.warmup_deadline
         train_alive = train_step is not None
+        inflight = deque()
         it = iter(stream)
         try:
             for batch in it:
                 if train_alive:
-                    ts = time.perf_counter()
-                    state, loss = train_step(state, batch)
-                    jax.block_until_ready(loss)
-                    step_time += time.perf_counter() - ts
+                    if args.step_timing or t0 is None:
+                        # warmup always blocks: the first step's compile
+                        # must finish before the window opens
+                        ts = time.perf_counter()
+                        state, loss = train_step(state, batch)
+                        jax.block_until_ready(loss)
+                        step_time += time.perf_counter() - ts
+                    else:
+                        state, loss = train_step(state, batch)
+                        inflight.append(loss)
+                        if len(inflight) > args.max_inflight:
+                            jax.block_until_ready(inflight.popleft())
                 else:
                     jax.block_until_ready(batch["image"])
                 n_batches += 1
@@ -172,6 +191,9 @@ def run(args):
                 measured += 1
                 if args.seconds and time.perf_counter() - t0 >= args.seconds:
                     break
+            # drain: queued steps must finish inside the measured window
+            while inflight:
+                jax.block_until_ready(inflight.popleft())
         finally:
             it.close()  # unwinds the prefetch thread promptly
             stream.close()
@@ -185,7 +207,11 @@ def run(args):
             "images_per_sec": images / elapsed,
             "sec_per_image": elapsed / images,
             "sec_per_batch": elapsed / measured,
-            "train_duty_cycle": (step_time / elapsed) if train_alive else None,
+            "train_duty_cycle": (
+                (step_time / elapsed)
+                if (train_alive and args.step_timing)
+                else None
+            ),
             "train_degraded": bool(train_step is not None and not train_alive),
             "stages": stats,
             "batches": measured,
@@ -216,6 +242,25 @@ def parse_args(argv=None):
     ap.add_argument("--height", type=int, default=480)
     ap.add_argument("--channels", type=int, default=4)
     ap.add_argument("--warmup-batches", type=int, default=8)
+    ap.add_argument(
+        "--prefetch",
+        type=int,
+        default=2,
+        help="device batches staged ahead (double buffering = 2)",
+    )
+    ap.add_argument(
+        "--max-inflight",
+        type=int,
+        default=4,
+        help="train steps dispatched ahead of execution (latency hiding); "
+        "bounds HBM held by queued batches",
+    )
+    ap.add_argument(
+        "--step-timing",
+        action="store_true",
+        help="block after every step and report train_duty_cycle "
+        "(adds one host<->device round trip per batch)",
+    )
     ap.add_argument(
         "--seconds",
         type=float,

@@ -40,18 +40,26 @@ def _load():
     with _BUILD_LOCK:
         if _LIB is not None or _LIB_ERR is not None:
             return _LIB
-        try:
-            src = os.path.join(_DIR, "ringbuf.cpp")
-            stale = not os.path.exists(_SO) or (
-                os.path.exists(src)
-                and os.path.getmtime(src) > os.path.getmtime(_SO)
-            )
-            if stale:
+        src = os.path.join(_DIR, "ringbuf.cpp")
+        stale = not os.path.exists(_SO) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO)
+        )
+        if stale:
+            try:
+                # Makefile builds to a temp name and renames atomically, so
+                # concurrent builders never expose a half-written .so
                 subprocess.run(
                     ["make", "-s"], cwd=_DIR, check=True, capture_output=True
                 )
+            except (OSError, subprocess.CalledProcessError) as e:
+                if not os.path.exists(_SO):
+                    _LIB_ERR = e
+                    return None
+                # no toolchain but a prebuilt .so exists: use it
+        try:
             lib = ctypes.CDLL(_SO)
-        except (OSError, subprocess.CalledProcessError) as e:
+        except OSError as e:
             _LIB_ERR = e
             return None
         lib.bjr_create.restype = ctypes.c_void_p
@@ -285,7 +293,9 @@ def fast_stack(items, out=None):
         if a.shape != first.shape or a.dtype != first.dtype:
             raise ValueError("fast_stack requires equal shapes and dtypes")
     lib = _load()
-    if lib is None:
+    if lib is None or first.dtype.hasobject:
+        # object dtypes hold PyObject pointers: a raw memcpy would skip the
+        # increfs and corrupt refcounts
         return np.stack(items, out=out)
     if out is None:
         out = np.empty((n,) + first.shape, dtype=first.dtype)

@@ -1,0 +1,70 @@
+"""Benchmark-harness regression tests (the reference ships
+``benchmarks/benchmark.py`` but never tests it — SURVEY.md §4 gap).
+
+Runs the real harness as a subprocess in a tiny configuration (small
+frames, short window, no train step) and asserts the JSON contract the
+driver relies on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks", "benchmark.py")
+
+
+def _run(extra, timeout=120):
+    env = os.environ.copy()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH", "")) if p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [
+            sys.executable,
+            BENCH,
+            "--instances", "2",
+            "--workers", "2",
+            "--batch", "4",
+            "--width", "64",
+            "--height", "64",
+            "--items", "100000000",
+            "--seconds", "2",
+            "--warmup-batches", "2",
+            "--warmup-deadline", "60",
+            "--no-train",
+            "--json",
+        ]
+        + extra,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [
+        ln for ln in out.stdout.splitlines() if ln.strip().startswith("{")
+    ][-1]
+    return json.loads(line)
+
+
+def test_benchmark_json_contract_tcp():
+    res = _run([])
+    assert res["unit"] == "images/sec"
+    assert res["value"] > 0
+    # value rounds to 2 decimals and vs_baseline to 3, so the two fields can
+    # disagree by up to 5e-4 + 0.012*5e-3 when both land on opposite edges
+    assert res["vs_baseline"] == pytest.approx(res["value"] * 0.012, abs=1e-3)
+
+
+def test_benchmark_json_contract_shm():
+    from blendjax.native import native_available
+
+    if not native_available():
+        pytest.skip("native ring not built")
+    res = _run(["--transport", "shm"])
+    assert res["value"] > 0
