@@ -172,10 +172,14 @@ class RemoteIterableDataset:
         finally:
             socket.close(0)
 
-    def _stream_shm(self, worker_id, num_workers, shard_id, num_shards, stop_event):
-        """Native-transport variant of the stream loop: round-robin over
-        this worker's rings; a closed+drained ring leaves the rotation
-        (producer exit ends the stream instead of raising a timeout)."""
+    def _shm_rotation(self, worker_id, num_workers, stop_event, consume, count):
+        """Shared ring-rotation loop for the shm paths: opens this worker's
+        rings, round-robins ``consume(reader, block_ms)`` over them, and
+        owns the EOF / timeout / stop semantics.  ``consume`` returns a
+        result to yield, None when no message arrived in its slice, or
+        raises EOFError when its ring is closed+drained (the ring then
+        leaves the rotation; producer exit ends the stream instead of
+        raising a timeout)."""
         from blendjax.native import ShmRingReader
 
         mine = self.addresses[worker_id::num_workers]
@@ -184,65 +188,339 @@ class RemoteIterableDataset:
         # ring creation waits on producer startup: give it the stream timeout
         open_ms = max(self.timeoutms, 10000)
         readers = [ShmRingReader(a, open_timeout_ms=open_ms) for a in mine]
-        count = self.max_items // (num_workers * num_shards)
         try:
-            with ExitStack() as es:
-                rec = None
-                if self.record_path_prefix is not None:
-                    rec = es.enter_context(
-                        FileRecorder(
-                            FileRecorder.filename(
-                                self.record_path_prefix,
-                                shard_id * num_workers + worker_id,
-                            ),
-                            self.max_items,
+            delivered = 0
+            waited_ms = 0
+            # single ring (the common case: one worker per producer):
+            # block inside the C call, 100 us wakeups.  Multi-ring:
+            # non-blocking rotation with a short host-side sleep.
+            block_ms = 100 if len(readers) == 1 else 0
+            while delivered < count and readers:
+                progressed = False
+                for reader in list(readers):
+                    if stop_event is not None and stop_event.is_set():
+                        return
+                    try:
+                        res = consume(reader, block_ms)
+                    except EOFError:
+                        reader.close(unlink=True)  # drained + closed
+                        readers.remove(reader)
+                        block_ms = 100 if len(readers) == 1 else 0
+                        continue
+                    if res is None:
+                        waited_ms += max(block_ms, 0)
+                        continue
+                    progressed = True
+                    waited_ms = 0
+                    yield res
+                    delivered += 1
+                    if delivered >= count:
+                        return
+                if not progressed:
+                    if block_ms == 0:
+                        time.sleep(0.001)
+                        waited_ms += 1
+                    if waited_ms >= self.timeoutms:
+                        raise TimeoutError(
+                            f"No message within {self.timeoutms} ms from {mine}"
                         )
-                    )
-                delivered = 0
-                waited_ms = 0
-                # single ring (the common case: one worker per producer):
-                # block inside the C call, 100 us wakeups.  Multi-ring:
-                # non-blocking rotation with a short host-side sleep.
-                block_ms = 100 if len(readers) == 1 else 0
-                while delivered < count and readers:
-                    progressed = False
-                    for reader in list(readers):
-                        if stop_event is not None and stop_event.is_set():
-                            return
-                        try:
-                            frames = reader.recv_frames(timeout_ms=block_ms)
-                        except EOFError:
-                            reader.close(unlink=True)  # drained + closed
-                            readers.remove(reader)
-                            block_ms = 100 if len(readers) == 1 else 0
-                            continue
-                        if frames is None:
-                            waited_ms += max(block_ms, 0)
-                            continue
-                        progressed = True
-                        waited_ms = 0
-                        if rec is not None:
-                            rec.save_frames(frames)
-                        yield self._item(wire.decode(frames))
-                        delivered += 1
-                        if delivered >= count:
-                            return
-                    if not progressed:
-                        if block_ms == 0:
-                            time.sleep(0.001)
-                            waited_ms += 1
-                        if waited_ms >= self.timeoutms:
-                            raise TimeoutError(
-                                f"No message within {self.timeoutms} ms from {mine}"
-                            )
         finally:
             for r in readers:
                 r.close()
+
+    def _stream_shm(self, worker_id, num_workers, shard_id, num_shards, stop_event):
+        """Native-transport variant of the stream loop (per-item)."""
+        count = self.max_items // (num_workers * num_shards)
+        with ExitStack() as es:
+            rec = None
+            if self.record_path_prefix is not None:
+                rec = es.enter_context(
+                    FileRecorder(
+                        FileRecorder.filename(
+                            self.record_path_prefix,
+                            shard_id * num_workers + worker_id,
+                        ),
+                        self.max_items,
+                    )
+                )
+
+            def consume(reader, block_ms):
+                frames = reader.recv_frames(timeout_ms=block_ms)
+                if frames is None:
+                    return None
+                if rec is not None:
+                    rec.save_frames(frames)
+                return (self._item(wire.decode(frames)),)
+
+            for (item,) in self._shm_rotation(
+                worker_id, num_workers, stop_event, consume, count
+            ):
+                yield item
 
     def _item(self, item):
         """Override point; defaults to ``item_transform`` (reference
         ``dataset.py:113-117``)."""
         return self.item_transform(item)
+
+    # -- batched zero-intermediate-copy path (shm transport) ---------------
+
+    def supports_batched_stream(self):
+        """True when :meth:`stream_batches` can assemble batches straight
+        out of the shm arena (native transport, no recording, no per-item
+        transform)."""
+        return (
+            bool(self.addresses)
+            and all(a.startswith("shm://") for a in self.addresses)
+            and self.record_path_prefix is None
+            and self.item_transform is _identity
+        )
+
+    def stream_batches(
+        self,
+        batch_size,
+        worker_id=0,
+        num_workers=1,
+        shard_id=0,
+        num_shards=1,
+        stop_event=None,
+        drop_last=True,
+        timer=None,
+    ):
+        """Yield collated batches, bypassing per-item materialization.
+
+        On the shm transport each message's array payloads normally cost
+        two consumer-side copies: arena -> frame buffer
+        (``recv_frames``), then frame buffers -> batch (``collate``).
+        This path holds each ring record open just long enough to memcpy
+        its payloads **directly into preallocated batch buffers**
+        (``recv_frames_view`` + ``copy_into``, GIL released) — one copy,
+        no intermediate allocations.
+
+        Falls back to ``stream()`` + collate when
+        :meth:`supports_batched_stream` is False.  Schema drift between
+        messages (changed shape/dtype for a key) degrades that key to the
+        generic collate rules instead of failing the stream.
+        """
+        from blendjax.btt.collate import collate as default_collate
+
+        if timer is None:
+            from blendjax.utils.timing import StageTimer
+
+            timer = StageTimer()
+        if not self.supports_batched_stream():
+            batch = []
+            for item in self.stream(
+                worker_id=worker_id,
+                num_workers=num_workers,
+                shard_id=shard_id,
+                num_shards=num_shards,
+                stop_event=stop_event,
+            ):
+                batch.append(item)
+                if len(batch) == batch_size:
+                    with timer.stage("collate"):
+                        out = default_collate(batch)
+                    yield out
+                    batch = []
+            if batch and not drop_last:
+                with timer.stage("collate"):
+                    out = default_collate(batch)
+                yield out
+            return
+
+        yield from self._stream_shm_batches(
+            batch_size,
+            worker_id,
+            num_workers,
+            shard_id,
+            num_shards,
+            stop_event,
+            drop_last,
+            timer,
+        )
+
+    def _stream_shm_batches(
+        self,
+        batch_size,
+        worker_id,
+        num_workers,
+        shard_id,
+        num_shards,
+        stop_event,
+        drop_last,
+        timer,
+    ):
+        count = self.max_items // (num_workers * num_shards)
+        state = {"builder": None}
+
+        def consume(reader, block_ms):
+            frames = reader.recv_frames_view(timeout_ms=block_ms)
+            if frames is None:
+                return None
+            try:
+                with timer.stage("collate"):
+                    if state["builder"] is None:
+                        state["builder"] = _BatchBuilder(batch_size)
+                    state["builder"].add_message(frames)
+            finally:
+                reader.release_record()
+            return True
+
+        for _ in self._shm_rotation(
+            worker_id, num_workers, stop_event, consume, count
+        ):
+            builder = state["builder"]
+            if builder is not None and builder.full():
+                yield builder.finish()
+                state["builder"] = None
+        builder = state["builder"]
+        if builder is not None and builder.count and not drop_last:
+            yield builder.finish()
+
+
+class _BatchBuilder:
+    """Assembles one collated batch directly from wire frames.
+
+    Array leaves (raw-buffer placeholders or ndarrays in compat pickles)
+    are memcpy'd into ``(batch_size, *shape)`` buffers preallocated on
+    first sight of each key; everything else accumulates in per-key lists
+    collated at the end.  Semantics mirror the generic
+    ``stream() + collate`` path exactly: a key whose shape/dtype drifts
+    mid-batch degrades to the ragged-list rules, keys absent from the
+    batch's first message are dropped, and a message *missing* a
+    first-message key raises KeyError (as dict collate would).
+    """
+
+    def __init__(self, batch_size):
+        import numpy as np
+
+        self._np = np
+        self.batch_size = batch_size
+        self.count = 0
+        self._stacked = {}  # path -> preallocated (B, ...) ndarray
+        self._lists = {}  # path -> list of leaves (generic collate at end)
+        self._paths = None  # schema from the first message
+
+    def full(self):
+        return self.count >= self.batch_size
+
+    # -- leaf walking -------------------------------------------------------
+
+    def _view(self, placeholder, payloads):
+        """ndarray view into the arena for a raw-buffer placeholder."""
+        np = self._np
+        return np.frombuffer(
+            payloads[placeholder[wire.ARRAY_PLACEHOLDER]],
+            dtype=np.dtype(placeholder["dtype"]),
+        ).reshape(placeholder["shape"])
+
+    def _resolve_copy(self, obj, payloads):
+        """Deep-resolve placeholders inside a container to *owned* arrays
+        (the arena views die when the record is released)."""
+        np = self._np
+        if wire.is_array_placeholder(obj):
+            return np.array(self._view(obj, payloads))
+        if isinstance(obj, dict):
+            return {k: self._resolve_copy(v, payloads) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            seq = [self._resolve_copy(v, payloads) for v in obj]
+            return seq if isinstance(obj, list) else tuple(seq)
+        return obj
+
+    def _walk(self, obj, payloads, path=()):
+        """Yield (path, leaf, is_array) with raw-buffer placeholders
+        resolved to ndarray views into the arena.  list/tuple containers
+        are resolved to owned copies and treated as single leaves — the
+        final ``collate`` recurses into them exactly like the generic
+        path does."""
+        np = self._np
+        if isinstance(obj, dict):
+            if wire.is_array_placeholder(obj):
+                yield path, self._view(obj, payloads), True
+                return
+            for k, v in obj.items():
+                yield from self._walk(v, payloads, path + (k,))
+            return
+        if isinstance(obj, np.ndarray):
+            yield path, obj, True
+            return
+        if isinstance(obj, (list, tuple)):
+            yield path, self._resolve_copy(obj, payloads), False
+            return
+        yield path, obj, False
+
+    def add_message(self, frames):
+        """Consume one message's frames (views valid only for this call)."""
+        from blendjax.native import copy_into
+
+        np = self._np
+        head = wire.loads(frames[0])
+        payloads = frames[1:]
+        i = self.count
+        seen = set()
+        for path, leaf, is_array in self._walk(head, payloads):
+            if self._paths is not None and path not in self._paths:
+                # generic collate keys the batch off its first item and
+                # silently drops keys that only appear later — match it
+                continue
+            seen.add(path)
+            if path in self._lists:
+                self._lists[path].append(
+                    np.array(leaf) if is_array else leaf
+                )
+                continue
+            if is_array and i == 0:
+                self._stacked[path] = np.empty(
+                    (self.batch_size,) + leaf.shape, leaf.dtype
+                )
+            buf = self._stacked.get(path)
+            if buf is not None and (
+                leaf.shape == buf.shape[1:] and leaf.dtype == buf.dtype
+            ):
+                copy_into(buf[i], leaf)
+                continue
+            # shape/dtype drift (or a non-array leaf): degrade this key to
+            # list mode, preserving earlier slots; the final collate then
+            # applies the same ragged/upcast rules as the generic path
+            prior = (
+                [buf[j] for j in range(i)]
+                if buf is not None
+                else self._lists.get(path, [])
+            )
+            self._stacked.pop(path, None)
+            self._lists[path] = list(prior) + [
+                np.array(leaf) if is_array else leaf
+            ]
+        if self._paths is None:
+            self._paths = seen
+        elif seen != self._paths:
+            # a slot without a value for a first-message key would silently
+            # misalign every later slot — fail loudly like dict collate
+            missing = sorted(map(str, self._paths - seen))
+            raise KeyError(
+                f"stream message {i} of the current batch is missing "
+                f"key(s) {missing} present in the batch's first message"
+            )
+        self.count += 1
+
+    def finish(self):
+        """Return the collated batch pytree (nested dict)."""
+        from blendjax.btt.collate import collate as list_collate
+
+        n = self.count
+        out = {}
+        for path, buf in self._stacked.items():
+            _set_path(out, path, buf if n == self.batch_size else buf[:n])
+        for path, vals in self._lists.items():
+            _set_path(out, path, list_collate(vals) if vals else vals)
+        return out
+
+
+def _set_path(tree, path, value):
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
 
 
 class SingleFileDataset:

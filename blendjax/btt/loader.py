@@ -111,6 +111,31 @@ class BatchLoader:
     def _worker(self, worker_id):
         shard_id, num_shards = self.shard
         try:
+            # default collate delegates batching to the dataset: on the
+            # native shm transport batches assemble straight out of the
+            # ring arena (one copy, no per-item intermediates); otherwise
+            # stream_batches falls back to stream()+collate internally.
+            # The manual loop below remains for custom collate_fn and
+            # stream()-only datasets.
+            if self.collate_fn is default_collate and hasattr(
+                self.dataset, "stream_batches"
+            ):
+                for out in self.dataset.stream_batches(
+                    self.batch_size,
+                    worker_id=worker_id,
+                    num_workers=self.num_workers,
+                    shard_id=shard_id,
+                    num_shards=num_shards,
+                    stop_event=self._stop,
+                    drop_last=self.drop_last,
+                    timer=self.timer,
+                ):
+                    if not self._put(out):
+                        return
+                    if self._stop.is_set():
+                        return
+                self._put(_SENTINEL)
+                return
             batch = []
             for item in self.dataset.stream(
                 worker_id=worker_id,

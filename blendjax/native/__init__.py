@@ -4,6 +4,7 @@ everything here is optional — the pure-ZMQ paths work without it."""
 from blendjax.native.ring import (  # noqa: F401
     ShmRingReader,
     ShmRingWriter,
+    copy_into,
     fast_stack,
     is_shm_address,
     native_available,

@@ -143,21 +143,29 @@ def _frame_ptr_len(obj):
 _NATIVE_COPY_MIN_BYTES = 64 * 1024
 
 
-def _unpack_frames(lib, base_addr: int, buf: memoryview):
-    """Parse a record written by ``bjr_write_v``:
-    u32 nframes | u64 len[n] | payloads.
-
-    Each payload is copied out of the arena exactly once — large frames via
-    ``bjr_gather`` with the GIL released (k loader threads copy on k
-    cores), small ones via ``bytes``.
-    """
-    import numpy as np
-
+def _split_record(buf: memoryview):
+    """Parse a record written by ``bjr_write_v`` —
+    ``u32 nframes | u64 len[n] | payloads`` — into (offset, length) pairs.
+    The single source of truth for the record framing."""
     (nframes,) = struct.unpack_from("<I", buf, 0)
     lens = struct.unpack_from(f"<{nframes}Q", buf, 4)
     off = 4 + 8 * nframes
-    frames = []
+    spans = []
     for ln in lens:
+        spans.append((off, ln))
+        off += ln
+    return spans
+
+
+def _unpack_frames(lib, base_addr: int, buf: memoryview):
+    """Copy a record's payloads out of the arena, exactly once each —
+    large frames via ``bjr_gather`` with the GIL released (k loader
+    threads copy on k cores), small ones via ``bytes``.
+    """
+    import numpy as np
+
+    frames = []
+    for off, ln in _split_record(buf):
         if ln >= _NATIVE_COPY_MIN_BYTES:
             out = np.empty(ln, np.uint8)
             ptrs = (ctypes.c_void_p * 1)(base_addr + off)
@@ -166,7 +174,6 @@ def _unpack_frames(lib, base_addr: int, buf: memoryview):
             frames.append(out)
         else:
             frames.append(bytes(buf[off : off + ln]))
-        off += ln
     return frames
 
 
@@ -257,6 +264,31 @@ class ShmRingReader:
         finally:
             self._lib.bjr_read_release(self._h)
 
+    def recv_frames_view(self, timeout_ms):
+        """Zero-copy variant of :meth:`recv_frames`: frames are memoryviews
+        **into the shm arena**, valid only until :meth:`release_record` —
+        which MUST be called before the next recv (it frees the ring slot;
+        the producer may be blocked on it).  Use when the payload is copied
+        exactly once into its final destination (e.g. a preallocated batch
+        buffer) instead of through an intermediate frame buffer.
+        """
+        data = ctypes.c_void_p()
+        length = ctypes.c_uint64()
+        rc = self._lib.bjr_read_acquire(
+            self._h, ctypes.byref(data), ctypes.byref(length), timeout_ms
+        )
+        if rc == -1:
+            return None
+        if rc == -3:
+            raise EOFError("producer closed")
+        buf = (ctypes.c_char * length.value).from_address(data.value)
+        mv = memoryview(buf)
+        return [mv[off : off + ln] for off, ln in _split_record(mv)]
+
+    def release_record(self):
+        """Release the record handed out by :meth:`recv_frames_view`."""
+        self._lib.bjr_read_release(self._h)
+
     def pending_bytes(self):
         return self._lib.bjr_pending(self._h)
 
@@ -273,6 +305,26 @@ def unlink_address(address):
         os.unlink(os.path.join("/dev/shm", name))
     except OSError:
         pass
+
+
+def copy_into(dst, src):
+    """memcpy one C-contiguous ndarray (or view) into another, GIL released
+    for large payloads.  Shapes/dtypes must already match; ``dst`` must be
+    C-contiguous (a leading-axis batch slot qualifies)."""
+    import numpy as np
+
+    lib = _load()
+    if (
+        lib is None
+        or dst.nbytes < _NATIVE_COPY_MIN_BYTES
+        or dst.dtype.hasobject
+        or not (dst.flags["C_CONTIGUOUS"] and src.flags["C_CONTIGUOUS"])
+    ):
+        np.copyto(dst, src)
+        return
+    ptrs = (ctypes.c_void_p * 1)(src.ctypes.data)
+    lens = (ctypes.c_uint64 * 1)(src.nbytes)
+    lib.bjr_gather(dst.ctypes.data_as(ctypes.c_void_p), ptrs, lens, 1)
 
 
 def fast_stack(items, out=None):

@@ -51,6 +51,15 @@ BTMID_KEY = "btmid"
 
 _ARRAY_PLACEHOLDER = "__bjx_nd__"
 
+#: Public alias: key under which a raw-buffer header stores the payload
+#: frame index for an ndarray leaf (consumed by the batched shm decode).
+ARRAY_PLACEHOLDER = _ARRAY_PLACEHOLDER
+
+
+def is_array_placeholder(obj) -> bool:
+    """True if ``obj`` is a raw-buffer header placeholder for an ndarray."""
+    return isinstance(obj, dict) and _ARRAY_PLACEHOLDER in obj
+
 
 def new_message_id() -> str:
     """Random 4-byte hex message id (reference ``duplex.py:63``)."""

@@ -33,3 +33,18 @@ import jax  # noqa: E402  (after env setup, before any test imports it)
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(__file__))  # tests/helpers importable
+
+
+import atexit  # noqa: E402
+import glob as _glob  # noqa: E402
+
+
+@atexit.register
+def _cleanup_test_shm_rings():
+    """Remove shm rings leaked by aborted/short-read tests (rings are only
+    auto-unlinked when a reader drains them to EOF)."""
+    for p in _glob.glob(f"/dev/shm/bjx-test-*-{os.getpid()}"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass

@@ -219,3 +219,187 @@ def test_recv_frames_large_payload_buffer_semantics():
     finally:
         r.close()
         w.close(unlink=True)
+
+
+def _produce_n(addr, btid, n, shape=(32, 32, 3), big_from=None):
+    """Publish n frames; from index big_from on, switch image shape
+    (schema-drift injection)."""
+    from blendjax.btb.publisher import DataPublisher
+
+    pub = DataPublisher(addr, btid=btid, raw_buffers=True, sndtimeoms=500)
+    i = 0
+    while i < n:
+        shp = shape if big_from is None or i < big_from else (shape[0] * 2,) + shape[1:]
+        img = np.full(shp, (btid * 10 + i) % 255, np.uint8)
+        if pub.publish(image=img, frameid=i, tag=f"f{i}"):
+            i += 1
+    pub.close()
+
+
+def test_stream_batches_matches_item_path():
+    """Zero-copy batch assembly must produce byte-identical batches to the
+    per-item stream + collate path."""
+    from blendjax.btt.collate import collate
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    shape = (64, 64, 4)  # 16KB/frame -> small-copy path; still exercises zc
+    addr_a, addr_b = _addr("zc-a"), _addr("zc-b")
+    ta = threading.Thread(target=_produce_n, args=(addr_a, 0, 16, shape), daemon=True)
+    tb = threading.Thread(target=_produce_n, args=(addr_b, 1, 16, shape), daemon=True)
+    ta.start()
+    ds = RemoteIterableDataset([addr_a], max_items=12, timeoutms=10000)
+    assert ds.supports_batched_stream()
+    batches = list(ds.stream_batches(4))
+    ta.join(timeout=10)
+    assert len(batches) == 3
+    for b in batches:
+        assert b["image"].shape == (4,) + shape
+        assert b["image"].dtype == np.uint8
+        assert b["btid"].tolist() == [0] * 4
+        assert len(b["tag"]) == 4 and isinstance(b["tag"][0], str)
+    # parity against the generic path on an identical stream
+    tb.start()
+    ds2 = RemoteIterableDataset([addr_b], max_items=12, timeoutms=10000)
+    items2 = list(ds2.stream())
+    ref = [collate(items2[i : i + 4]) for i in range(0, 12, 4)]
+    tb.join(timeout=10)
+    for b, r in zip(batches, ref):
+        # same frames modulo btid (different producer ids)
+        np.testing.assert_array_equal(
+            b["image"][:, :, :, 0] - b["btid"][0] * 10 % 255,
+            r["image"][:, :, :, 0] - r["btid"][0] * 10 % 255,
+        )
+        np.testing.assert_array_equal(b["frameid"], r["frameid"])
+
+
+def test_stream_batches_partial_and_drop_last():
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    addr = _addr("zc-partial")
+    t = threading.Thread(target=_produce_n, args=(addr, 0, 10), daemon=True)
+    t.start()
+    ds = RemoteIterableDataset([addr], max_items=10, timeoutms=10000)
+    batches = list(ds.stream_batches(4, drop_last=False))
+    t.join(timeout=10)
+    assert [b["image"].shape[0] for b in batches] == [4, 4, 2]
+    assert batches[-1]["frameid"].tolist() == [8, 9]
+
+
+def test_stream_batches_schema_drift_degrades():
+    """A key whose shape changes mid-batch degrades to the ragged-list
+    collate rules instead of failing the stream."""
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    addr = _addr("zc-drift")
+    t = threading.Thread(
+        target=_produce_n, args=(addr, 0, 8), kwargs={"big_from": 2}, daemon=True
+    )
+    t.start()
+    ds = RemoteIterableDataset([addr], max_items=8, timeoutms=10000)
+    batches = list(ds.stream_batches(4))
+    t.join(timeout=10)
+    assert len(batches) == 2
+    first = batches[0]
+    assert isinstance(first["image"], list)  # ragged -> list of arrays
+    assert first["image"][0].shape == (32, 32, 3)
+    assert first["image"][2].shape == (64, 32, 3)
+    # second batch is uniform again (all big frames) -> stacked
+    assert batches[1]["image"].shape == (4, 64, 32, 3)
+
+
+def test_loader_uses_batched_stream_on_shm(tmp_path):
+    from blendjax.btt.dataset import RemoteIterableDataset
+    from blendjax.btt.loader import BatchLoader
+
+    addr = _addr("zc-loader")
+    t = threading.Thread(target=_produce_n, args=(addr, 3, 16), daemon=True)
+    t.start()
+    ds = RemoteIterableDataset([addr], max_items=16, timeoutms=10000)
+    with BatchLoader(ds, batch_size=8, num_workers=1) as loader:
+        batches = list(loader)
+    t.join(timeout=10)
+    assert len(batches) == 2
+    assert batches[0]["image"].shape == (8, 32, 32, 3)
+    assert batches[0]["btid"].tolist() == [3] * 8
+
+
+def test_stream_batches_nested_container_arrays():
+    """Arrays nested inside list values must decode (not leak raw
+    placeholders) and stack exactly like the generic collate path."""
+    from blendjax.btb.publisher import DataPublisher
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    addr = _addr("zc-nested")
+
+    def produce():
+        pub = DataPublisher(addr, btid=0, raw_buffers=True, sndtimeoms=500)
+        i = 0
+        while i < 8:
+            pts = [np.full((3, 2), i, np.float32), np.full((3, 2), i + 1, np.float32)]
+            if pub.publish(points=pts, frameid=i):
+                i += 1
+        pub.close()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    ds = RemoteIterableDataset([addr], max_items=8, timeoutms=10000)
+    batches = list(ds.stream_batches(4))
+    t.join(timeout=10)
+    assert len(batches) == 2
+    pts = batches[0]["points"]
+    # list of 2 positions, each stacked over the batch -> (4, 3, 2)
+    assert isinstance(pts, list) and len(pts) == 2
+    assert pts[0].shape == (4, 3, 2) and pts[0].dtype == np.float32
+    np.testing.assert_array_equal(pts[0][:, 0, 0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(pts[1][:, 0, 0], [1, 2, 3, 4])
+
+
+def test_stream_batches_key_semantics_match_generic_collate():
+    """Missing first-message key -> KeyError; extra later key -> dropped."""
+    from blendjax.btb.publisher import DataPublisher
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    addr = _addr("zc-keys")
+
+    def produce(msgs):
+        pub = DataPublisher(addr, btid=0, raw_buffers=True, sndtimeoms=500)
+        i = 0
+        while i < len(msgs):
+            if pub.publish(**msgs[i]):
+                i += 1
+        pub.close()
+
+    img = np.zeros((4, 4), np.uint8)
+    # message 2 grows an extra key (dropped); message 3 is complete again
+    msgs = [
+        {"image": img, "frameid": 0},
+        {"image": img, "frameid": 1},
+        {"image": img, "frameid": 2, "extra": 7},
+        {"image": img, "frameid": 3},
+    ]
+    t = threading.Thread(target=produce, args=(msgs,), daemon=True)
+    t.start()
+    ds = RemoteIterableDataset([addr], max_items=4, timeoutms=10000)
+    (batch,) = list(ds.stream_batches(4))
+    t.join(timeout=10)
+    assert "extra" not in batch
+    assert batch["frameid"].tolist() == [0, 1, 2, 3]
+
+    # missing key fails loudly instead of silently misaligning slots
+    addr2 = _addr("zc-keys2")
+
+    def produce2():
+        pub = DataPublisher(addr2, btid=0, raw_buffers=True, sndtimeoms=500)
+        ms = [{"image": img, "frameid": 0}, {"image": img}]
+        i = 0
+        while i < len(ms):
+            if pub.publish(**ms[i]):
+                i += 1
+        pub.close()
+
+    t2 = threading.Thread(target=produce2, daemon=True)
+    t2.start()
+    ds2 = RemoteIterableDataset([addr2], max_items=2, timeoutms=10000)
+    with pytest.raises(KeyError):
+        list(ds2.stream_batches(2))
+    t2.join(timeout=10)
