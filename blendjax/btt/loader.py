@@ -57,6 +57,7 @@ class BatchLoader:
         shard=(0, 1),
         drop_last=True,
         prefetch_batches=2,
+        timer=None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -66,7 +67,7 @@ class BatchLoader:
         self.collate_fn = collate_fn or default_collate
         self.shard = shard
         self.drop_last = drop_last
-        self.timer = StageTimer()
+        self.timer = timer or StageTimer()
         self._queue = queue.Queue(maxsize=max(2, prefetch_batches))
         self._stop = threading.Event()
         self._threads = []

@@ -141,6 +141,7 @@ class JaxStream:
         shard=(0, 1),
         drop_last=True,
         collate_fn=None,
+        timer=None,
     ):
         from blendjax.btt.loader import BatchLoader
 
@@ -151,6 +152,7 @@ class JaxStream:
             shard=shard,
             drop_last=drop_last,
             collate_fn=collate_fn,
+            timer=timer,
         )
         self.sharding = sharding
         self.transform = transform

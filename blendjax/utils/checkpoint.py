@@ -66,3 +66,103 @@ def save_train_state(path, state):
 def load_train_state(path, template_state):
     """Restore a TrainState into ``template_state``'s structure."""
     return load_pytree(path, template_state)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention and latest-step resume.
+
+    Two backends:
+
+    - ``'npz'`` (default): one atomic ``step_<N>.npz`` per step via
+      :func:`save_pytree` — dependency-free, host-local arrays.
+    - ``'orbax'``: ``orbax.checkpoint.PyTreeCheckpointer`` per step —
+      sharding-aware (restores multi-host ``jax.Array`` states in place on
+      TPU pods); requires the ``orbax-checkpoint`` package.
+
+    Usage::
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        mgr.save(step, state)
+        state = mgr.restore(template_state)        # latest
+        start = (mgr.latest_step() or -1) + 1      # resume loop
+    """
+
+    def __init__(self, directory, max_to_keep=3, backend="npz"):
+        if backend not in ("npz", "orbax"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.backend = backend
+        os.makedirs(self.directory, exist_ok=True)
+        if backend == "orbax":
+            import orbax.checkpoint as ocp
+
+            self._ckptr = ocp.PyTreeCheckpointer()
+
+    # -- step bookkeeping ---------------------------------------------------
+
+    def _path(self, step):
+        name = f"step_{step:08d}"
+        return os.path.join(
+            self.directory, name + (".npz" if self.backend == "npz" else "")
+        )
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            if not name.startswith("step_"):
+                continue
+            stem = name.split(".")[0]
+            try:
+                steps.append(int(stem[len("step_"):]))
+            except ValueError:
+                continue
+        return sorted(set(steps))
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save / restore -----------------------------------------------------
+
+    def save(self, step, state):
+        path = self._path(step)
+        if self.backend == "npz":
+            save_pytree(path, state)
+        else:
+            self._ckptr.save(path, jax.tree.map(lambda x: x, state), force=True)
+        self._retain()
+        return path
+
+    def restore(self, template, step=None):
+        """Restore ``step`` (default: latest) into ``template``'s
+        structure.  Raises FileNotFoundError when no checkpoint exists."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+        path = self._path(step)
+        if self.backend == "npz":
+            return load_pytree(path, template)
+        restored = self._ckptr.restore(path, item=template)
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        new_leaves = jax.tree_util.tree_leaves(restored)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def _retain(self):
+        if self.max_to_keep is None:
+            return
+        import shutil
+
+        steps = self.all_steps()
+        for step in steps[: max(0, len(steps) - self.max_to_keep)]:
+            path = self._path(step)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass

@@ -11,10 +11,19 @@ Usage::
     ...
     timer.summary()   # {'recv': {'count': n, 'total_s': t, 'mean_ms': m}, ...}
     timer.duty_cycle("step")   # fraction of wall time inside 'step'
+
+Pass ``trace=True`` to additionally record one event per stage interval
+and ``export_chrome_trace(path)`` them as Chrome trace-event JSON —
+loadable in ``chrome://tracing`` / Perfetto, with loader workers, the
+prefetch thread and the train loop on separate rows so feed stalls are
+visible as gaps.  Tracing is off by default (zero per-stage overhead
+beyond the two timestamps).
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from collections import defaultdict
@@ -25,14 +34,16 @@ class StageTimer:
     """Accumulates wall-clock time per named stage (thread-safe: stages are
     recorded from loader workers and the prefetch thread concurrently)."""
 
-    def __init__(self):
+    def __init__(self, trace=False):
         self._lock = threading.Lock()
+        self._trace = bool(trace)
         self.reset()
 
     def reset(self):
         with self._lock:
             self._total = defaultdict(float)
             self._count = defaultdict(int)
+            self._events = []
             self._start = time.perf_counter()
 
     @contextmanager
@@ -41,12 +52,17 @@ class StageTimer:
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            self.add(name, time.perf_counter() - t0, _t0=t0)
 
-    def add(self, name, seconds):
+    def add(self, name, seconds, _t0=None):
         with self._lock:
             self._total[name] += seconds
             self._count[name] += 1
+            if self._trace:
+                start = _t0 if _t0 is not None else time.perf_counter() - seconds
+                self._events.append(
+                    (name, start, seconds, threading.get_ident())
+                )
 
     @property
     def wall_s(self):
@@ -83,3 +99,31 @@ class StageTimer:
                 }
                 for name, total in self._total.items()
             }
+
+    def export_chrome_trace(self, path):
+        """Write recorded intervals as Chrome trace-event JSON
+        (``chrome://tracing`` / Perfetto).  Requires ``trace=True``;
+        raises RuntimeError otherwise.  One row per thread; timestamps are
+        relative to the last :meth:`reset`."""
+        if not self._trace:
+            raise RuntimeError(
+                "tracing is off; construct StageTimer(trace=True)"
+            )
+        with self._lock:
+            events = list(self._events)
+            origin = self._start
+        pid = os.getpid()
+        out = [
+            {
+                "name": name,
+                "ph": "X",  # complete event: begin + duration
+                "pid": pid,
+                "tid": tid,
+                "ts": (start - origin) * 1e6,  # microseconds
+                "dur": dur * 1e6,
+            }
+            for name, start, dur, tid in events
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+        return len(out)

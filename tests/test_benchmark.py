@@ -68,3 +68,29 @@ def test_benchmark_json_contract_shm():
         pytest.skip("native ring not built")
     res = _run(["--transport", "shm"])
     assert res["value"] > 0
+
+
+def test_rl_benchmark_json_contract():
+    env = os.environ.copy()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH", "")) if p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "rl_benchmark.py"),
+            "--instances", "2",
+            "--seconds", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=90,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [ln for ln in out.stdout.splitlines() if ln.strip().startswith("{")][-1]
+    res = json.loads(line)
+    assert res["metric"] == "rl_steps_per_sec_no_image"
+    assert res["value"] > 0
+    assert res["vs_baseline"] == pytest.approx(res["value"] / 2000.0, abs=1e-3)

@@ -64,3 +64,52 @@ def test_structure_mismatch_raises(tmp_path):
         load_pytree(path, {"a": jnp.ones(3), "b": jnp.ones(2)})
     with pytest.raises(ValueError, match="shape"):
         load_pytree(path, {"a": jnp.ones(4)})
+
+
+def _tiny_state():
+    params = detector.init(
+        jax.random.PRNGKey(0), num_keypoints=2, channels=(4,), hidden=8
+    )
+    return TrainState.create(params, optax.adam(1e-3))
+
+
+def test_manager_save_restore_latest(tmp_path):
+    from blendjax.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=2)
+    state = _tiny_state()
+    for step in (0, 5, 10):
+        mgr.save(step, state)
+    # retention keeps the newest two
+    assert mgr.all_steps() == [5, 10]
+    assert mgr.latest_step() == 10
+    restored = mgr.restore(_tiny_state())
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored)[0]),
+        np.asarray(jax.tree.leaves(state)[0]),
+    )
+    # explicit step
+    restored5 = mgr.restore(_tiny_state(), step=5)
+    assert jax.tree.structure(restored5) == jax.tree.structure(state)
+
+
+def test_manager_empty_raises(tmp_path):
+    from blendjax.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "empty")
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(_tiny_state())
+
+
+def test_manager_orbax_backend(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from blendjax.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ockpt", max_to_keep=1, backend="orbax")
+    state = _tiny_state()
+    mgr.save(3, state)
+    mgr.save(7, state)
+    assert mgr.all_steps() == [7]
+    restored = mgr.restore(_tiny_state())
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
