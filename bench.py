@@ -1,38 +1,53 @@
 """Driver benchmark: one JSON line on stdout, guaranteed.
 
-Measures the blendjax end-to-end streaming pipeline on the reference's own
-headline configuration (``Readme.md:92``: Cube scene 640x480 RGBA, 4
-producer instances, 4 workers, batch 8 — 0.012 sec/image there): synthetic
-producers speaking the real wire protocol -> fan-in PULL -> threaded batch
-loader -> double-buffered device_put into TPU HBM -> detector train step per
-batch.  Rendering is excluded (Blender cannot run in this image), so
-``vs_baseline`` compares transport+train throughput against the reference's
-full-pipeline number.
+Orchestrates ``benchmarks/suite.py`` (a child process that measures the
+end-to-end pipeline in progressive phases, emitting a JSON line per phase
+the moment it completes) plus ``benchmarks/rl_benchmark.py`` (the
+reference's second headline number), and assembles the driver's single
+JSON line from whatever arrived.
 
-Robustness: the jax measurement runs in a child process under a hard
-deadline (TPU-tunnel device init / first compile can stall for minutes).
-If the child cannot deliver, a host-only pipeline measurement (recv +
-collate, no jax) is reported instead — the driver always gets its line.
+Honest labeling (the reference's 0.012 s/image *includes* Blender
+rendering; ours cannot — Blender does not run in this image — so the
+streamed pixels come from synthetic producers speaking the real wire
+protocol):
 
-``vs_baseline`` = measured images/sec x 0.012 (reference 4-instance
-sec/image), i.e. >1.0 beats the reference's best published configuration.
+- ``includes_rendering``: always false here; ``vs_baseline`` therefore
+  compares transport+train throughput against the reference's
+  full-pipeline number and must be read with that asterisk.
+- both configurations are reported side by side: ``stream_to_hbm`` (feed
+  only) and ``stream_to_train`` (feed + detector step), plus the
+  MXU-bound ``seqformer`` phase with train duty cycle and MFU — the
+  BASELINE.md north-star measurements.
+
+Robustness: the child emits per-phase lines immediately, so a deadline
+kill still yields every completed phase (round 1 lost its TPU numbers to
+an all-or-nothing child timeout).  The JAX persistent compilation cache
+(``.jax_cache/``) absorbs first-compile cost across runs.  If no phase
+arrives at all, a host-only measurement (recv + collate, no jax) is taken
+in-process — the driver always gets its line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 
-CHILD_BUDGET_S = 540  # warmup deadline (420) + window (45) + slack
+HERE = os.path.dirname(os.path.abspath(__file__))
+# driver kills around 540+; leave slack for fallback ($BJX_BENCH_BUDGET
+# overrides for quick local runs)
+TOTAL_BUDGET_S = float(os.environ.get("BJX_BENCH_BUDGET", 520))
+RL_BUDGET_S = 90
+REF_SEC_PER_IMAGE = 0.012  # reference 4-instance number, rendering included
 
 
 def host_only_fallback(seconds=10.0):
     """Measure the host half of the pipeline (no jax): producers -> fan-in
     recv -> collate."""
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from benchmarks.benchmark import launch_producers
 
     from blendjax.btt.dataset import RemoteIterableDataset
@@ -62,79 +77,157 @@ def host_only_fallback(seconds=10.0):
                 p.kill()
 
 
+def run_child_collect_json(cmd, env, deadline_s):
+    """Run a child, reading stdout live; return parsed JSON lines.
+
+    On deadline the child's process group is killed — lines already
+    received are kept (the whole point of progressive emission)."""
+    lines = []
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=None,  # inherit: suite diagnostics must reach driver logs
+        text=True,
+        cwd=HERE,
+        env=env,
+        start_new_session=True,
+    )
+
+    def reader():
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    lines.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        proc.wait(timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"child {cmd[1]} hit {deadline_s:.0f}s deadline\n")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        proc.wait(timeout=10)
+        _sweep_shm()  # killed producers never unlink their rings
+    t.join(timeout=5)
+    return lines
+
+
+def _sweep_shm():
+    """Remove shm rings leaked by SIGKILLed suite runs (the producers'
+    unlink path never runs under killpg); names are pid-unique so each
+    killed run would otherwise strand ~64 MiB per producer in /dev/shm."""
+    import glob
+
+    for path in glob.glob("/dev/shm/bjx-suite-*"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def main():
-    here = os.path.dirname(os.path.abspath(__file__))
-    sys.path.insert(0, here)
-    # fastest transport available: native shared-memory rings + zero-copy
-    # raw-buffer framing; tcp+pickle only if the native lib can't build
+    sys.path.insert(0, HERE)
     try:
         from blendjax.native import native_available
 
         native = native_available()
     except Exception:
         native = False
-    # Fleet size follows the host: the reference's 4 instances x 4 workers
-    # assumes cores to run them on; on a 1-2 core TPU-VM frontend the
-    # process thrash halves throughput, so scale the fleet down and lean on
-    # deep device prefetch instead (the tunnel pipelines ~12 batches well).
-    cores = os.cpu_count() or 1
-    instances = 4 if cores >= 4 else 1
-    workers = 4 if cores >= 4 else 1
-    cmd = [
-        sys.executable,
-        os.path.join(here, "benchmarks", "benchmark.py"),
-        "--instances", str(instances),
-        "--workers", str(workers),
-        "--batch", "8",
-        "--items", "100000000",
-        "--seconds", "45",
-        "--warmup-deadline", "420",
-        "--prefetch", "12",
-        "--json",
-    ]
-    if native:
-        # raw framing only pays off on shm (tcp multipart adds syscalls)
-        cmd += ["--raw", "--transport", "shm"]
-    else:
-        cmd += ["--pickle"]  # tcp fallback: single-frame pickle is faster
-    # child needs blendjax importable; child_env() prepends the repo root
-    # without replacing PYTHONPATH, which may carry the TPU plugin
-    # registration (axon sitecustomize)
     from blendjax.btt.launcher import child_env
 
     env = child_env()
-    try:
-        out = subprocess.run(
-            cmd,
-            capture_output=True,
-            text=True,
-            timeout=CHILD_BUDGET_S,
-            cwd=here,
-            env=env,
-        )
-        for line in reversed(out.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{") and '"metric"' in line:
-                print(line)
-                return
-        sys.stderr.write(
-            f"benchmark child exited {out.returncode} without JSON; "
-            f"stderr tail: {out.stderr[-2000:]}\n"
-        )
-    except subprocess.TimeoutExpired:
-        sys.stderr.write("benchmark child exceeded deadline; falling back\n")
+    # persistent compile cache: first round pays the compiles, every later
+    # run (and re-run within a round) hits the cache
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(HERE, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
-    ips = host_only_fallback()
-    print(
-        json.dumps(
-            {
-                "metric": "cube640x480_images_per_sec_host_stream_only",
-                "value": round(ips, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(ips * 0.012, 3),
-            }
+    t_start = time.monotonic()
+    cores = os.cpu_count() or 1
+    instances = 4 if cores >= 4 else 1
+    workers = 4 if cores >= 4 else 1
+    suite_budget = max(60.0, TOTAL_BUDGET_S - RL_BUDGET_S - 30)
+    cmd = [
+        sys.executable,
+        os.path.join(HERE, "benchmarks", "suite.py"),
+        "--budget", str(suite_budget),
+        "--instances", str(instances),
+        "--workers", str(workers),
+        "--batch", "8",
+        "--prefetch", "12",
+    ]
+    cmd += ["--raw", "--transport", "shm"] if native else ["--pickle"]
+    phases = {
+        p.get("phase"): p
+        for p in run_child_collect_json(cmd, env, suite_budget + 30)
+    }
+
+    rl = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 30:
+        rl_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "rl_benchmark.py"),
+                "--instances", str(instances),
+                "--seconds", "8",
+            ],
+            env,
+            min(RL_BUDGET_S, remaining),
         )
-    )
+        rl = rl_lines[-1] if rl_lines else None
+
+    extras = {"includes_rendering": False}
+    hbm = phases.get("stream_to_hbm")
+    train = phases.get("stream_to_train")
+    seq = phases.get("seqformer_train")
+    if hbm:
+        extras["stream_to_hbm_images_per_sec"] = hbm["items_per_sec"]
+    if train:
+        extras["train_duty_cycle"] = train.get("train_duty_cycle")
+        extras["detector_step_ms"] = round(train["step_s"] * 1e3, 3)
+    if seq:
+        extras["seqformer"] = {
+            k: seq[k]
+            for k in (
+                "tokens_per_sec",
+                "train_duty_cycle",
+                "mfu",
+                "step_s",
+                "device_kind",
+                "model_flops_per_sec",
+            )
+            if k in seq
+        }
+    if rl:
+        extras["rl_steps_per_sec"] = rl.get("value")
+        extras["rl_vs_baseline"] = rl.get("vs_baseline")
+
+    if train:
+        ips = train["items_per_sec"]
+        metric, degraded = "cube640x480_images_per_sec_stream_to_train", False
+    elif hbm:
+        ips = hbm["items_per_sec"]
+        metric, degraded = "cube640x480_images_per_sec_stream_to_hbm", True
+    else:
+        sys.stderr.write("no suite phases arrived; host-only fallback\n")
+        ips = host_only_fallback()
+        metric, degraded = "cube640x480_images_per_sec_host_stream_only", True
+
+    out = {
+        "metric": metric,
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips * REF_SEC_PER_IMAGE, 3),
+        "train_degraded": degraded,
+    }
+    out.update(extras)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,12 +1,19 @@
-"""Synthetic frame producer for benchmarks — stands in for one Blender
-instance rendering the Cube scene (640x480 RGBA, reference
-``benchmarks/benchmark.py:7-10``), speaking the real wire protocol via the
-real DataPublisher.  Run as: ``python stream_producer.py --addr tcp://...
---btid 0 [--raw] [--width W --height H]``.
+"""Synthetic producer for benchmarks — stands in for one Blender instance,
+speaking the real wire protocol via the real DataPublisher.
 
-A small pool of pre-generated frames is cycled so producer-side CPU work
-models serialization + send, not RNG; the rendered-pixel content does not
-affect transport/decode cost.
+Two modes:
+
+- ``frame`` (default): Cube-scene stand-in (640x480 RGBA, reference
+  ``benchmarks/benchmark.py:7-10``) — one image + keypoints per message.
+- ``episode``: world-model training feed — one (T+1, D) float32
+  observation sequence per message, the SeqFormer workload (an episode of
+  streamed observations; see ``blendjax/models/seqformer.py``).
+
+A small pool of pre-generated payloads is cycled so producer-side CPU work
+models serialization + send, not RNG; payload content does not affect
+transport/decode cost.
+
+Run as: ``python stream_producer.py --addr tcp://... --btid 0 [--raw]``.
 """
 
 from __future__ import annotations
@@ -22,27 +29,39 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--addr", required=True)
     ap.add_argument("--btid", type=int, default=0)
+    ap.add_argument("--mode", choices=["frame", "episode"], default="frame")
     ap.add_argument("--width", type=int, default=640)
     ap.add_argument("--height", type=int, default=480)
     ap.add_argument("--channels", type=int, default=4)
+    ap.add_argument("--seq-len", type=int, default=513,
+                    help="episode mode: observations per episode (T+1)")
+    ap.add_argument("--obs-dim", type=int, default=32)
     ap.add_argument("--raw", action="store_true", help="zero-copy wire encoding")
     ap.add_argument("--pool", type=int, default=16)
     args = ap.parse_args()
 
     rng = np.random.default_rng(args.btid)
-    frames = [
-        rng.integers(0, 255, (args.height, args.width, args.channels), dtype=np.uint8)
-        for _ in range(args.pool)
-    ]
-    xys = [
-        rng.random((8, 2)).astype(np.float32) for _ in range(args.pool)
-    ]
+    if args.mode == "frame":
+        payloads = [
+            {
+                "image": rng.integers(
+                    0, 255, (args.height, args.width, args.channels), dtype=np.uint8
+                ),
+                "xy": rng.random((8, 2)).astype(np.float32),
+            }
+            for _ in range(args.pool)
+        ]
+    else:
+        payloads = [
+            {"obs_seq": rng.standard_normal(
+                (args.seq_len, args.obs_dim)).astype(np.float32)}
+            for _ in range(args.pool)
+        ]
 
     pub = DataPublisher(args.addr, btid=args.btid, raw_buffers=args.raw)
     frameid = 0
     while True:  # terminated by the benchmark harness
-        i = frameid % args.pool
-        pub.publish(image=frames[i], xy=xys[i], frameid=frameid)
+        pub.publish(frameid=frameid, **payloads[frameid % args.pool])
         frameid += 1
 
 

@@ -273,6 +273,7 @@ class RemoteIterableDataset:
             and all(a.startswith("shm://") for a in self.addresses)
             and self.record_path_prefix is None
             and self.item_transform is _identity
+            and type(self)._item is RemoteIterableDataset._item
         )
 
     def stream_batches(

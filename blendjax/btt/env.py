@@ -144,14 +144,42 @@ def _gym_module():
 
 _gym = _gym_module()
 
+#: True when the adapter's backing module is gymnasium, whose API differs
+#: from classic gym: ``step`` returns a 5-tuple with separate
+#: ``terminated``/``truncated`` flags and ``reset`` returns ``(obs, info)``.
+USING_GYMNASIUM = _gym is not None and _gym.__name__ == "gymnasium"
+
+
+def adapt_step_result(obs, reward, done, info, gymnasium_api):
+    """Convert the wire-level ``(obs, reward, done, info)`` to the backing
+    module's ``step`` contract.
+
+    Under gymnasium: ``(obs, reward, terminated, truncated, info)``.  The
+    producer's ``done`` means task termination (e.g. the pole fell); the
+    remote protocol has no separate time-limit signal, so ``truncated`` is
+    always False — wrap with ``gymnasium.wrappers.TimeLimit`` for episode
+    caps.  Under classic gym: the legacy 4-tuple, unchanged."""
+    if gymnasium_api:
+        return obs, reward, bool(done), False, info
+    return obs, reward, done, info
+
+
 if _gym is not None:
 
     class OpenAIRemoteEnv(_gym.Env):
         """gym/gymnasium adapter over :func:`launch_env`
         (reference ``btt/env.py:195-313``).  Subclass, call
-        :meth:`launch` with your scene/script, and register with gym."""
+        :meth:`launch` with your scene/script, and register with gym.
 
-        metadata = {"render.modes": ["rgb_array", "human"]}
+        The adapter follows whichever module backs it: under gymnasium,
+        ``step`` returns the 5-tuple ``(obs, reward, terminated,
+        truncated, info)`` and ``reset`` returns ``(obs, info)``; under
+        classic gym, the legacy 4-tuple and bare-obs reset."""
+
+        metadata = {
+            "render.modes": ["rgb_array", "human"],  # classic gym key
+            "render_modes": ["rgb_array", "human"],
+        }
 
         def __init__(self, version="0.0.1"):
             self.__version__ = version
@@ -167,9 +195,16 @@ if _gym is not None:
 
         def step(self, action):
             obs, reward, done, info = self._env.step(action)
-            return obs, reward, done, info
+            return adapt_step_result(obs, reward, done, info, USING_GYMNASIUM)
 
-        def reset(self):
+        def reset(self, *, seed=None, options=None):
+            if USING_GYMNASIUM:
+                # seeds the np_random generator per the gymnasium contract;
+                # the remote scene's randomization is seeded at launch
+                # (-btseed), so a mid-run seed only affects local sampling
+                super().reset(seed=seed)
+                obs, info = self._env.reset()
+                return obs, info
             obs, _ = self._env.reset()
             return obs
 

@@ -62,45 +62,56 @@ def _load():
         except OSError as e:
             _LIB_ERR = e
             return None
-        lib.bjr_create.restype = ctypes.c_void_p
-        lib.bjr_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
-        lib.bjr_open.restype = ctypes.c_void_p
-        lib.bjr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.bjr_write.restype = ctypes.c_int
-        lib.bjr_write.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-            ctypes.c_int,
-        ]
-        lib.bjr_write_v.restype = ctypes.c_int
-        lib.bjr_write_v.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint32,
-            ctypes.c_int,
-        ]
-        lib.bjr_read_acquire.restype = ctypes.c_int
-        lib.bjr_read_acquire.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_int,
-        ]
-        lib.bjr_read_release.argtypes = [ctypes.c_void_p]
-        lib.bjr_pending.restype = ctypes.c_uint64
-        lib.bjr_pending.argtypes = [ctypes.c_void_p]
-        lib.bjr_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.bjr_gather.restype = None
-        lib.bjr_gather.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint64,
-        ]
+        try:
+            _bind(lib)
+        except AttributeError as e:
+            # A prebuilt .so from an older build can lack newer symbols
+            # (e.g. bjr_gather); treat it as unavailable so callers
+            # degrade to the tcp path instead of raising on every call.
+            _LIB_ERR = e
+            return None
         _LIB = lib
         return _LIB
+
+
+def _bind(lib):
+    lib.bjr_create.restype = ctypes.c_void_p
+    lib.bjr_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.bjr_open.restype = ctypes.c_void_p
+    lib.bjr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.bjr_write.restype = ctypes.c_int
+    lib.bjr_write.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    lib.bjr_write_v.restype = ctypes.c_int
+    lib.bjr_write_v.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint32,
+        ctypes.c_int,
+    ]
+    lib.bjr_read_acquire.restype = ctypes.c_int
+    lib.bjr_read_acquire.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int,
+    ]
+    lib.bjr_read_release.argtypes = [ctypes.c_void_p]
+    lib.bjr_pending.restype = ctypes.c_uint64
+    lib.bjr_pending.argtypes = [ctypes.c_void_p]
+    lib.bjr_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bjr_gather.restype = None
+    lib.bjr_gather.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+    ]
 
 
 def native_available() -> bool:

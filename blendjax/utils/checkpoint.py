@@ -112,6 +112,15 @@ class CheckpointManager:
         for name in os.listdir(self.directory):
             if not name.startswith("step_"):
                 continue
+            path = os.path.join(self.directory, name)
+            # Only count complete slots: an interrupted save leaves a
+            # 'step_N.npz.tmp' behind (save_pytree writes tmp then renames)
+            # which must not shadow a real step or poison latest_step().
+            if self.backend == "npz":
+                if not name.endswith(".npz") or not os.path.isfile(path):
+                    continue
+            elif not os.path.isdir(path):
+                continue
             stem = name.split(".")[0]
             try:
                 steps.append(int(stem[len("step_"):]))

@@ -113,3 +113,24 @@ def test_manager_orbax_backend(tmp_path):
     restored = mgr.restore(_tiny_state())
     for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_manager_ignores_interrupted_tmp_saves(tmp_path):
+    """A leftover 'step_N.npz.tmp' from a save killed mid-write must not be
+    counted as a step: latest_step() would point at a nonexistent .npz and
+    _retain() could evict a valid checkpoint in favor of the phantom slot."""
+    from blendjax.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=2)
+    state = _tiny_state()
+    mgr.save(0, state)
+    mgr.save(5, state)
+    # simulate an interrupted save at step 10
+    (tmp_path / "ckpt" / "step_00000010.npz.tmp").write_bytes(b"partial")
+    assert mgr.all_steps() == [0, 5]
+    assert mgr.latest_step() == 5
+    restored = mgr.restore(_tiny_state())
+    assert jax.tree.structure(restored) == jax.tree.structure(state)
+    # a further save retains real steps, not the phantom
+    mgr.save(12, state)
+    assert mgr.all_steps() == [5, 12]

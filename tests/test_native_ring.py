@@ -223,16 +223,25 @@ def test_recv_frames_large_payload_buffer_semantics():
 
 def _produce_n(addr, btid, n, shape=(32, 32, 3), big_from=None):
     """Publish n frames; from index big_from on, switch image shape
-    (schema-drift injection)."""
+    (schema-drift injection).  Bounded by a deadline so a consumer that
+    reads fewer than n items (ring full -> publish timeouts) doesn't leave
+    this thread spinning until interpreter exit."""
+    import time
+
     from blendjax.btb.publisher import DataPublisher
 
     pub = DataPublisher(addr, btid=btid, raw_buffers=True, sndtimeoms=500)
+    deadline = time.monotonic() + 30.0
+    stalls = 0
     i = 0
-    while i < n:
+    while i < n and stalls < 6 and time.monotonic() < deadline:
         shp = shape if big_from is None or i < big_from else (shape[0] * 2,) + shape[1:]
         img = np.full(shp, (btid * 10 + i) % 255, np.uint8)
         if pub.publish(image=img, frameid=i, tag=f"f{i}"):
             i += 1
+            stalls = 0
+        else:
+            stalls += 1
     pub.close()
 
 
@@ -403,3 +412,31 @@ def test_stream_batches_key_semantics_match_generic_collate():
     with pytest.raises(KeyError):
         list(ds2.stream_batches(2))
     t2.join(timeout=10)
+
+
+def test_item_override_disables_batched_stream():
+    """A subclass overriding _item() (the documented override point) must
+    NOT be routed through the zero-copy batched path, which would silently
+    skip its per-item transform; it falls back to stream() + collate and
+    the transform is applied."""
+    from blendjax.btt.dataset import RemoteIterableDataset
+    from blendjax.btt.loader import BatchLoader
+
+    class Doubling(RemoteIterableDataset):
+        def _item(self, item):
+            item["frameid"] = item["frameid"] * 2
+            return item
+
+    addr = _addr("zc-override")
+    t = threading.Thread(target=_produce_n, args=(addr, 0, 8), daemon=True)
+    t.start()
+    ds = Doubling([addr], max_items=8, timeoutms=10000)
+    assert not ds.supports_batched_stream()
+    with BatchLoader(ds, batch_size=4, num_workers=1) as loader:
+        batches = list(loader)
+    t.join(timeout=10)
+    assert len(batches) == 2
+    got = sorted(
+        int(v) for b in batches for v in np.asarray(b["frameid"]).ravel()
+    )
+    assert got == [0, 2, 4, 6, 8, 10, 12, 14]
