@@ -5,9 +5,11 @@ The reference detects producer death only when the user polls
 (``launcher.py:166-171``, ``dataset.py:98-99`` — SURVEY.md §5: "No restart,
 no elasticity").  ``FleetWatchdog`` watches the fleet from a background
 thread and reports deaths promptly; with ``restart=True`` it respawns dead
-instances with their original command line — streams reconnect
-transparently because producers bind and consumers keep their connect-mode
-sockets (tcp transport).
+instances with their original command line.  Streams heal transparently on
+both transports: tcp because producers bind and consumers keep their
+connect-mode sockets; shm because the respawned producer recreates the
+ring and :class:`blendjax.native.ring.ShmRingReader` detects the identity
+change and remaps the new generation (rc -4 reopen path).
 """
 
 from __future__ import annotations

@@ -18,12 +18,14 @@ TPU-first design decisions:
   :func:`blendjax.parallel.make_ring_attention` output to run the sequence
   axis sharded over the mesh (ring or Ulysses), nothing to change in the
   model;
-- optional **mixture-of-experts MLP** (``n_experts > 0``): a soft mixture
-  computed densely (every expert evaluated, gate-weighted sum) so shapes
-  stay static; expert weights stack on a leading axis that shards over an
-  ``'expert'`` mesh axis — XLA turns the gate-weighted contraction into a
-  psum over the expert shards (expert parallelism without ragged
-  dispatch).
+- optional **mixture-of-experts MLP** (``n_experts > 0``): expert weights
+  stack on a leading axis that shards over an ``'expert'`` mesh axis.
+  Two apply-time evaluation modes over the SAME parameters:
+  ``moe_impl='dense'`` (soft mixture, every expert evaluated, gate-
+  weighted psum over the expert shards) and ``moe_impl='topk'`` (routed
+  expert parallelism — top-k gating with capacity factor, static-shaped
+  GShard-style dispatch, dropped tokens ride the residual; see
+  :mod:`blendjax.models.moe`).
 """
 
 from __future__ import annotations
@@ -127,19 +129,15 @@ def init(
     return params
 
 
-def apply(params, obs, attn_fn=None, compute_dtype=jnp.bfloat16):
-    """Forward pass: (B, T, obs_dim) -> (B, T, obs_dim) next-obs prediction.
-
-    ``attn_fn(q, k, v) -> out`` with (B, T, H, Dh) tensors; defaults to
-    single-device causal :func:`full_attention`.  Pass a
-    ``make_ring_attention(mesh, causal=True, ...)`` closure to shard the
-    sequence axis.
-    """
+def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
+             moe_capacity_factor):
+    """Shared forward: returns (prediction, list of per-layer MoE aux)."""
     if attn_fn is None:
         def attn_fn(q, k, v):
             return full_attention(q, k, v, causal=True)
 
     b, t, _ = obs.shape
+    auxs = []
     x = dense_apply(params["embed"], obs.astype(compute_dtype), dtype=compute_dtype)
     x = x + params["pos"][:t].astype(compute_dtype)[None]
     for blk in params["blocks"]:
@@ -154,24 +152,65 @@ def apply(params, obs, attn_fn=None, compute_dtype=jnp.bfloat16):
         x = x + o + blk["wo"]["b"].astype(compute_dtype)
         h = _ln_apply(blk["ln2"], x)
         if "moe" in blk:
-            x = x + _moe_apply(blk["moe"], h, compute_dtype)
+            if moe_impl == "topk":
+                from blendjax.models.moe import moe_apply_topk
+
+                y, aux = moe_apply_topk(
+                    blk["moe"], h, compute_dtype, k=moe_k,
+                    capacity_factor=moe_capacity_factor,
+                )
+                auxs.append(aux["aux_loss"])
+                x = x + y
+            elif moe_impl == "dense":
+                x = x + _moe_apply(blk["moe"], h, compute_dtype)
+            else:
+                raise ValueError(f"unknown moe_impl {moe_impl!r}")
         else:
             h = gelu(dense_apply(blk["mlp"]["fc"], h, dtype=compute_dtype))
             x = x + dense_apply(blk["mlp"]["proj"], h, dtype=compute_dtype)
     x = _ln_apply(params["ln_f"], x)
-    return dense_apply(params["head"], x, dtype=jnp.float32)
+    return dense_apply(params["head"], x, dtype=jnp.float32), auxs
 
 
-def loss_fn(params, batch, attn_fn=None, compute_dtype=jnp.bfloat16):
-    """MSE next-observation loss.
+def apply(params, obs, attn_fn=None, compute_dtype=jnp.bfloat16,
+          moe_impl="dense", moe_k=2, moe_capacity_factor=1.25):
+    """Forward pass: (B, T, obs_dim) -> (B, T, obs_dim) next-obs prediction.
+
+    ``attn_fn(q, k, v) -> out`` with (B, T, H, Dh) tensors; defaults to
+    single-device causal :func:`full_attention`.  Pass a
+    ``make_ring_attention(mesh, causal=True, ...)`` closure to shard the
+    sequence axis.  ``moe_impl``: 'dense' evaluates every expert
+    (gate-weighted mixture), 'topk' routes each token to ``moe_k`` experts
+    under a capacity bound (:mod:`blendjax.models.moe`).
+    """
+    out, _ = _forward(
+        params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
+        moe_capacity_factor,
+    )
+    return out
+
+
+def loss_fn(params, batch, attn_fn=None, compute_dtype=jnp.bfloat16,
+            moe_impl="dense", moe_k=2, moe_capacity_factor=1.25,
+            moe_aux_weight=0.0):
+    """MSE next-observation loss (+ optional MoE load-balance aux term).
 
     ``batch = {'obs': (B,T,D), 'target': (B,T,D)}`` — the target is the
     obs sequence shifted host-side (so the device-side loss needs no
-    cross-shard shift when T is sequence-sharded).
+    cross-shard shift when T is sequence-sharded).  With
+    ``moe_impl='topk'`` and ``moe_aux_weight > 0`` the Switch-style load
+    balance loss (mean over layers) is added, pushing the router toward
+    uniform expert load.
     """
-    pred = apply(params, batch["obs"], attn_fn=attn_fn, compute_dtype=compute_dtype)
+    pred, auxs = _forward(
+        params, batch["obs"], attn_fn, compute_dtype, moe_impl, moe_k,
+        moe_capacity_factor,
+    )
     err = pred - batch["target"].astype(jnp.float32)
-    return jnp.mean(err * err)
+    loss = jnp.mean(err * err)
+    if auxs and moe_aux_weight:
+        loss = loss + moe_aux_weight * sum(auxs) / len(auxs)
+    return loss
 
 
 def make_episode_batch(obs_seq):

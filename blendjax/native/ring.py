@@ -102,6 +102,8 @@ def _bind(lib):
         ctypes.c_int,
     ]
     lib.bjr_read_release.argtypes = [ctypes.c_void_p]
+    lib.bjr_vanished.restype = ctypes.c_int
+    lib.bjr_vanished.argtypes = [ctypes.c_void_p]
     lib.bjr_pending.restype = ctypes.c_uint64
     lib.bjr_pending.argtypes = [ctypes.c_void_p]
     lib.bjr_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -235,19 +237,64 @@ class ShmRingWriter:
 
 
 class ShmRingReader:
-    """Consumer end of a shm ring (dataset backend)."""
+    """Consumer end of a shm ring (dataset backend).
 
-    def __init__(self, address, open_timeout_ms=10000):
+    Elasticity: a producer that crashes and is respawned (e.g. by
+    :class:`blendjax.btt.watchdog.FleetWatchdog`) recreates the ring under
+    the same name — a new shm object the old mapping cannot see.  The
+    native layer detects the identity change (rc -4) and, with
+    ``auto_reopen`` (default), the reader transparently remaps the new
+    generation and keeps streaming; ``reconnects`` counts generations for
+    observability.  In-flight records of the dead generation that were
+    fully written are drained first; partially-written ones were never
+    visible (head publishes only complete records).
+    """
+
+    def __init__(self, address, open_timeout_ms=10000, auto_reopen=True):
         lib = _load()
         if lib is None:
             raise RuntimeError(
                 f"native ring unavailable (build failed: {_LIB_ERR}); use tcp"
             )
         self._lib = lib
-        name = shm_name_from_address(address)
-        self._h = lib.bjr_open(name.encode(), open_timeout_ms)
+        self._name = shm_name_from_address(address)
+        self._auto_reopen = auto_reopen
+        self.reconnects = 0
+        self._h = lib.bjr_open(self._name.encode(), open_timeout_ms)
         if not self._h:
-            raise OSError(f"failed to open shm ring {name}")
+            raise OSError(f"failed to open shm ring {self._name}")
+
+    def _acquire(self, data, length, timeout_ms):
+        """read_acquire with vanished-ring reopen inside the deadline."""
+        import time
+
+        deadline = time.monotonic() + max(timeout_ms, 0) / 1e3
+        while True:
+            rc = self._lib.bjr_read_acquire(
+                self._h, ctypes.byref(data), ctypes.byref(length), timeout_ms
+            )
+            if rc != -4:
+                return rc
+            remaining_ms = int((deadline - time.monotonic()) * 1e3)
+            if not self._auto_reopen:
+                raise ConnectionResetError(
+                    f"shm ring {self._name} vanished (producer died)"
+                )
+            self._lib.bjr_close(self._h, 0)
+            self._h = None
+            if remaining_ms <= 0:
+                raise ConnectionResetError(
+                    f"shm ring {self._name} vanished; producer not back "
+                    f"within the timeout"
+                )
+            h = self._lib.bjr_open(self._name.encode(), remaining_ms)
+            if not h:
+                raise ConnectionResetError(
+                    f"shm ring {self._name} vanished; reopen timed out"
+                )
+            self._h = h
+            self.reconnects += 1
+            timeout_ms = max(int((deadline - time.monotonic()) * 1e3), 0)
 
     def recv_frames(self, timeout_ms):
         """Next framed message as a list of buffer-like frames, or None on
@@ -258,13 +305,13 @@ class ShmRingReader:
         must treat frames as buffers (``memoryview``-compatible), not as
         ``bytes`` specifically — :func:`blendjax.wire.decode` does.
 
-        Raises EOFError when the producer closed and the ring is drained.
+        Raises EOFError when the producer closed and the ring is drained,
+        ConnectionResetError when the producer vanished and did not come
+        back within the timeout.
         """
         data = ctypes.c_void_p()
         length = ctypes.c_uint64()
-        rc = self._lib.bjr_read_acquire(
-            self._h, ctypes.byref(data), ctypes.byref(length), timeout_ms
-        )
+        rc = self._acquire(data, length, timeout_ms)
         if rc == -1:
             return None
         if rc == -3:
@@ -285,9 +332,7 @@ class ShmRingReader:
         """
         data = ctypes.c_void_p()
         length = ctypes.c_uint64()
-        rc = self._lib.bjr_read_acquire(
-            self._h, ctypes.byref(data), ctypes.byref(length), timeout_ms
-        )
+        rc = self._acquire(data, length, timeout_ms)
         if rc == -1:
             return None
         if rc == -3:

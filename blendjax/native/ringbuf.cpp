@@ -49,6 +49,9 @@ struct Handle {
   char name[256];
   int owner;          // created (vs opened)
   uint64_t last_rec;  // bytes to release after read_acquire
+  dev_t st_dev;       // identity of the mapped shm object: a respawned
+  ino_t st_ino;       // producer's bjr_create makes a NEW object under the
+                      // same name; the reader detects the inode change
 };
 
 inline uint64_t pad8(uint64_t n) { return (n + 7) & ~7ULL; }
@@ -81,6 +84,8 @@ void* bjr_create(const char* name, uint64_t capacity) {
     shm_unlink(name);
     return nullptr;
   }
+  struct stat id_st;
+  fstat(fd, &id_st);
   void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) {
@@ -101,6 +106,8 @@ void* bjr_create(const char* name, uint64_t capacity) {
   std::strncpy(h->name, name, sizeof(h->name) - 1);
   h->owner = 1;
   h->last_rec = 0;
+  h->st_dev = id_st.st_dev;
+  h->st_ino = id_st.st_ino;
   return h;
 }
 
@@ -143,7 +150,23 @@ void* bjr_open(const char* name, int timeout_ms) {
   std::strncpy(h->name, name, sizeof(h->name) - 1);
   h->owner = 0;
   h->last_rec = 0;
+  h->st_dev = st.st_dev;
+  h->st_ino = st.st_ino;
   return h;
+}
+
+// 0: the mapped object is still what `name` resolves to.
+// 1: `name` resolves to a DIFFERENT object (producer respawned, bjr_create
+//    unlinked + recreated) or no longer exists (crashed, not yet back).
+int bjr_vanished(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  int fd = shm_open(h->name, O_RDONLY, 0600);
+  if (fd < 0) return 1;
+  struct stat st;
+  int ok = fstat(fd, &st) == 0 && st.st_dev == h->st_dev &&
+           st.st_ino == h->st_ino;
+  close(fd);
+  return ok ? 0 : 1;
 }
 
 namespace {
@@ -225,13 +248,17 @@ int bjr_write_v(void* handle, const void* const* bufs, const uint64_t* lens,
 
 // Acquire the next record without copying.  *data points into the shm
 // arena and stays valid until bjr_read_release.  Returns 0 ok, -1 timeout,
-// -3 producer closed and ring drained.
+// -3 producer closed and ring drained, -4 ring vanished/recreated under
+// this mapping (producer crashed or was respawned; reopen to continue).
+// Buffered records are always drained before -4 is reported — a crash
+// mid-write is invisible (head only advances after a complete record).
 int bjr_read_acquire(void* handle, const void** data, uint64_t* len,
                      int timeout_ms) {
   auto* h = static_cast<Handle*>(handle);
   Header* hdr = h->hdr;
   const uint64_t cap = hdr->capacity;
   uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms < 0 ? 0 : timeout_ms);
+  uint64_t next_vanish_check = now_ms() + 50;
 
   for (;;) {
     uint64_t tail = hdr->tail.load(std::memory_order_relaxed);
@@ -251,6 +278,10 @@ int bjr_read_acquire(void* handle, const void** data, uint64_t* len,
     }
     if (hdr->producer_closed.load(std::memory_order_acquire)) return -3;
     if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    if (!h->owner && now_ms() >= next_vanish_check) {
+      if (bjr_vanished(handle)) return -4;
+      next_vanish_check = now_ms() + 50;
+    }
     sleep_us(100);
   }
 }

@@ -136,6 +136,10 @@ def make_seqformer_train_step(
     model_axis="model",
     expert_axis=None,
     attn_impl="ring",
+    moe_impl="dense",
+    moe_k=2,
+    moe_capacity_factor=1.25,
+    moe_aux_weight=0.0,
 ):
     """4-way-parallel training step for the SeqFormer world-model.
 
@@ -143,7 +147,10 @@ def make_seqformer_train_step(
     batch dp-sharded over ``data_axis``, sequence sharded over ``seq_axis``
     (ring attention — or Ulysses with ``attn_impl='ulysses'``), attention
     heads + MLP tensor-parallel over ``model_axis``, MoE experts over
-    ``expert_axis`` (see :func:`seqformer_rules`).
+    ``expert_axis`` (see :func:`seqformer_rules`).  ``moe_impl='topk'``
+    switches the expert layer from the dense mixture to routed expert
+    parallelism (top-k gating + capacity, :mod:`blendjax.models.moe`) with
+    an optional load-balance aux loss.
 
     Returns ``(init_sharded, step, batch_sharding)``; device_put batches
     with ``batch_sharding`` (leading dims sharded data x seq).
@@ -162,7 +169,14 @@ def make_seqformer_train_step(
         head_axis=model_axis if attn_impl == "ring" else None,
     )
     rules = seqformer_rules(model_axis, expert_axis)
-    loss = functools.partial(seqformer.loss_fn, attn_fn=attn)
+    loss = functools.partial(
+        seqformer.loss_fn,
+        attn_fn=attn,
+        moe_impl=moe_impl,
+        moe_k=moe_k,
+        moe_capacity_factor=moe_capacity_factor,
+        moe_aux_weight=moe_aux_weight,
+    )
     init_sharded, step = make_sharded_train_step(
         loss, optimizer, mesh, rules=rules, data_axis=data_axis
     )

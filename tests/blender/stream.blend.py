@@ -1,0 +1,25 @@
+"""Producer fixture: streams incrementing frameids forever (terminated by
+the launcher / killed by crash-injection tests).  Works on tcp and shm
+addresses alike; bounded publish timeout keeps backpressure from hanging
+the process past termination."""
+
+import numpy as np
+
+from blendjax.btb.arguments import parse_blendtorch_args
+from blendjax.btb.publisher import DataPublisher
+
+
+def main():
+    args, _ = parse_blendtorch_args()
+    pub = DataPublisher(
+        args.btsockets["DATA"], btid=args.btid, raw_buffers=True,
+        sndtimeoms=500,
+    )
+    frameid = 0
+    img = np.zeros((16, 16, 3), np.uint8)
+    while True:
+        if pub.publish(image=img, frameid=frameid, btid=args.btid):
+            frameid += 1
+
+
+main()

@@ -1,7 +1,7 @@
-"""A minimal fake ``bpy`` emulating the animation/handler machinery blendjax
-touches, so AnimationController's callback ordering is golden-testable in CI
-(the reference can only test this against real Blender,
-``tests/test_animation.py``).
+"""A minimal fake ``bpy``/``gpu``/``mathutils`` emulating the Blender
+surfaces blendjax touches, so producer-side code is testable in CI (the
+reference can only test this against real Blender,
+``tests/test_animation.py``, ``tests/test_camera.py``).
 
 Faithful behaviors:
 - ``scene.frame_set(f)`` synchronously fires ``frame_change_pre`` then
@@ -11,12 +11,305 @@ Faithful behaviors:
   from frame_end back to frame_start.
 - ``SpaceView3D.draw_handler_add`` registers POST_PIXEL draw callbacks the
   pump may fire multiple times per frame (to exercise the dedupe guard).
+- ``gpu.types.GPUOffScreen.draw_view3d`` synthesizes a deterministic
+  GL-convention framebuffer (row 0 = bottom, float32 linear RGBA; sRGB
+  encode when ``do_color_management``) and ``texture_color.read()``
+  returns a buffer-protocol object, so OffScreenRenderer's readback /
+  flip / gamma logic runs for real.
+- ``mathutils.Matrix/Vector`` implement the exact subset blendjax calls
+  (``normalized``/``inverted``/``@``/``translation``/``to_track_quat``),
+  numpy-backed, with Blender's conventions (column-normalized basis,
+  XYZ euler order, camera looking down -Z).
+- camera objects implement ``calc_matrix_camera`` with Blender's PERSP /
+  ORTHO projection formulas (AUTO sensor fit), so the bpy Camera adapter
+  is golden-testable against analytic projections.
 """
 
 from __future__ import annotations
 
 import sys
 import types
+
+import numpy as np
+
+
+# -- mathutils ------------------------------------------------------------
+
+
+class Vector:
+    """numpy-backed stand-in for ``mathutils.Vector``."""
+
+    def __init__(self, seq=(0.0, 0.0, 0.0)):
+        self._v = np.array([float(c) for c in seq])
+
+    @property
+    def x(self):
+        return self._v[0]
+
+    @property
+    def y(self):
+        return self._v[1]
+
+    @property
+    def z(self):
+        return self._v[2]
+
+    def __sub__(self, other):
+        return Vector(self._v - np.asarray(tuple(other)))
+
+    def __add__(self, other):
+        return Vector(self._v + np.asarray(tuple(other)))
+
+    def normalized(self):
+        n = np.linalg.norm(self._v)
+        return Vector(self._v / n) if n > 0 else Vector(self._v)
+
+    def to_track_quat(self, track, up):
+        if (track, up) != ("-Z", "Y"):
+            raise NotImplementedError(f"track {track!r} up {up!r}")
+        return _TrackQuat(self._v)
+
+    def __iter__(self):
+        return iter(self._v.tolist())
+
+    def __len__(self):
+        return len(self._v)
+
+    def __array__(self, dtype=None, copy=None):
+        return self._v.astype(dtype) if dtype else self._v.copy()
+
+    def __repr__(self):
+        return f"Vector({self._v.tolist()})"
+
+
+def _rotmat_from_euler_xyz(ex, ey, ez):
+    cx, sx = np.cos(ex), np.sin(ex)
+    cy, sy = np.cos(ey), np.sin(ey)
+    cz, sz = np.cos(ez), np.sin(ez)
+    rx = np.array([[1, 0, 0], [0, cx, -sx], [0, sx, cx]])
+    ry = np.array([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]])
+    rz = np.array([[cz, -sz, 0], [sz, cz, 0], [0, 0, 1]])
+    return rz @ ry @ rx  # Blender 'XYZ' order: X applied first
+
+
+def _euler_xyz_from_rotmat(r):
+    ey = np.arcsin(np.clip(-r[2, 0], -1.0, 1.0))
+    ex = np.arctan2(r[2, 1], r[2, 2])
+    ez = np.arctan2(r[1, 0], r[0, 0])
+    return (ex, ey, ez)
+
+
+class _TrackQuat:
+    """Result of ``Vector.to_track_quat('-Z', 'Y')``: rotation taking the
+    -Z axis onto the direction, +Y as up reference (Blender cameras look
+    down -Z)."""
+
+    def __init__(self, direction):
+        d = np.asarray(direction, float)
+        n = np.linalg.norm(d)
+        z = -d / n  # camera -Z points along direction
+        y_ref = np.array([0.0, 1.0, 0.0])
+        x = np.cross(y_ref, z)
+        if np.linalg.norm(x) < 1e-8:  # direction parallel to Y
+            x = np.array([1.0, 0.0, 0.0])
+        x = x / np.linalg.norm(x)
+        y = np.cross(z, x)
+        self._r = np.stack([x, y, z], axis=1)  # columns = basis vectors
+
+    def to_euler(self):
+        return _euler_xyz_from_rotmat(self._r)
+
+
+class Matrix:
+    """numpy-backed stand-in for ``mathutils.Matrix`` (4x4)."""
+
+    def __init__(self, rows=None):
+        self._m = np.eye(4) if rows is None else np.array(
+            [[float(v) for v in row] for row in rows]
+        )
+
+    @classmethod
+    def from_rt(cls, r3, t3):
+        m = np.eye(4)
+        m[:3, :3] = r3
+        m[:3, 3] = np.asarray(tuple(t3))
+        return cls(m)
+
+    def normalized(self):
+        """Column-normalized basis, like ``mathutils.Matrix.normalized``
+        (strips scale; Blender's view matrix derivation relies on it)."""
+        m = self._m.copy()
+        for c in range(3):
+            n = np.linalg.norm(m[:3, c])
+            if n > 0:
+                m[:3, c] /= n
+        return Matrix(m)
+
+    def inverted(self):
+        return Matrix(np.linalg.inv(self._m))
+
+    @property
+    def translation(self):
+        return Vector(self._m[:3, 3])
+
+    def __matmul__(self, other):
+        if isinstance(other, Matrix):
+            return Matrix(self._m @ other._m)
+        v = np.asarray(tuple(other), float)
+        if v.shape == (3,):
+            out = self._m @ np.append(v, 1.0)
+            return Vector(out[:3] / out[3] if out[3] not in (0.0, 1.0) else out[:3])
+        return Vector(self._m @ v)
+
+    def __iter__(self):
+        return iter(self._m.tolist())
+
+    def __array__(self, dtype=None, copy=None):
+        return self._m.astype(dtype) if dtype else self._m.copy()
+
+
+# -- camera / mesh objects -------------------------------------------------
+
+
+class FakeCameraData:
+    def __init__(self, type="PERSP", lens=50.0, sensor_width=36.0,
+                 ortho_scale=6.0, clip_start=0.1, clip_end=100.0):
+        self.type = type
+        self.lens = lens
+        self.sensor_width = sensor_width
+        self.ortho_scale = ortho_scale
+        self.clip_start = clip_start
+        self.clip_end = clip_end
+
+
+class FakeCameraObject:
+    """Camera object: euler+location pose, Blender projection formulas."""
+
+    def __init__(self, location=(0.0, 0.0, 5.0), data=None):
+        self.location = Vector(location)
+        self._euler = (0.0, 0.0, 0.0)
+        self.data = data or FakeCameraData()
+
+    @property
+    def rotation_euler(self):
+        return self._euler
+
+    @rotation_euler.setter
+    def rotation_euler(self, euler):
+        self._euler = tuple(euler)
+
+    @property
+    def matrix_world(self):
+        return Matrix.from_rt(
+            _rotmat_from_euler_xyz(*self._euler), self.location
+        )
+
+    def calc_matrix_camera(self, depsgraph, x, y):
+        """Blender's camera projection (AUTO sensor fit: the sensor spans
+        the larger image dimension; reference semantics of
+        ``bpy.types.Object.calc_matrix_camera``)."""
+        aspect = x / y
+        n, f = self.data.clip_start, self.data.clip_end
+        if self.data.type == "ORTHO":
+            s = 2.0 / self.data.ortho_scale
+            sx, sy = (s, s * aspect) if aspect >= 1 else (s / aspect, s)
+            return Matrix([
+                [sx, 0, 0, 0],
+                [0, sy, 0, 0],
+                [0, 0, -2.0 / (f - n), -(f + n) / (f - n)],
+                [0, 0, 0, 1],
+            ])
+        fx = 2.0 * self.data.lens / self.data.sensor_width
+        px, py = (fx, fx * aspect) if aspect >= 1 else (fx / aspect, fx)
+        return Matrix([
+            [px, 0, 0, 0],
+            [0, py, 0, 0],
+            [0, 0, (n + f) / (n - f), 2 * n * f / (n - f)],
+            [0, 0, -1, 0],
+        ])
+
+
+class FakeMeshObject:
+    """Mesh object with explicit local-space vertices; evaluated_get
+    returns itself (depsgraph evaluation is an identity here)."""
+
+    def __init__(self, vertices, location=(0.0, 0.0, 0.0), users=1):
+        self.data = types.SimpleNamespace(
+            vertices=[types.SimpleNamespace(co=Vector(v)) for v in vertices]
+        )
+        vs = np.asarray(vertices, float)
+        lo, hi = vs.min(0), vs.max(0)
+        self.bound_box = [
+            (xx, yy, zz) for xx in (lo[0], hi[0])
+            for yy in (lo[1], hi[1]) for zz in (lo[2], hi[2])
+        ]
+        self.matrix_world = Matrix.from_rt(np.eye(3), location)
+        self.users = users
+
+    def evaluated_get(self, depsgraph):
+        return self
+
+
+def cube_mesh(half=1.0, location=(0.0, 0.0, 0.0), users=1):
+    corners = [
+        (sx * half, sy * half, sz * half)
+        for sx in (-1, 1) for sy in (-1, 1) for sz in (-1, 1)
+    ]
+    return FakeMeshObject(corners, location=location, users=users)
+
+
+# -- gpu module ------------------------------------------------------------
+
+
+class _GPUTextureColor:
+    def __init__(self, owner):
+        self._owner = owner
+
+    def read(self):
+        """Buffer-protocol float32 RGBA, like ``gpu.types.Buffer`` in
+        Blender 3.x (zero-copy ``np.asarray``-able)."""
+        img = self._owner._framebuffer
+        if img is None:
+            raise RuntimeError("draw_view3d was never called")
+        return memoryview(np.ascontiguousarray(img).reshape(-1))
+
+
+class FakeGPUOffScreen:
+    """Synthesizes a deterministic 'render': R = row gradient (bottom=0,
+    GL convention), G = column gradient, B = 0.25, A = 1; sRGB-encoded
+    when ``do_color_management`` (what Blender's color management does on
+    its linear output)."""
+
+    def __init__(self, width, height):
+        self.width = width
+        self.height = height
+        self._framebuffer = None
+        self.freed = False
+        self.draw_calls = []
+        self.texture_color = _GPUTextureColor(self)
+
+    def draw_view3d(self, scene, view_layer, space, region, view_matrix,
+                    proj_matrix, do_color_management=False):
+        h, w = self.height, self.width
+        self.draw_calls.append({
+            "scene": scene,
+            "view_matrix": np.asarray(view_matrix),
+            "proj_matrix": np.asarray(proj_matrix),
+            "do_color_management": do_color_management,
+        })
+        rows = np.linspace(0.0, 1.0, h, dtype=np.float32)[:, None]
+        cols = np.linspace(0.0, 1.0, w, dtype=np.float32)[None, :]
+        img = np.empty((h, w, 4), np.float32)
+        img[..., 0] = rows  # row 0 (bottom) darkest
+        img[..., 1] = cols
+        img[..., 2] = 0.25
+        img[..., 3] = 1.0
+        if do_color_management:
+            img[..., :3] = img[..., :3] ** (1.0 / 2.2)
+        self._framebuffer = img
+
+    def free(self):
+        self.freed = True
 
 
 class _Handlers:
@@ -43,6 +336,11 @@ class _Scene:
         self.frame_end = 250
         self.frame_current = 1
         self.rigidbody_world = _RigidBodyWorld()
+        self.camera = FakeCameraObject()
+        self.render = types.SimpleNamespace(
+            resolution_x=320, resolution_y=240, resolution_percentage=100
+        )
+        self.ray_cast_target = None  # object every ray hits (visibility)
 
     def frame_set(self, frame):
         self.frame_current = frame
@@ -50,6 +348,10 @@ class _Scene:
             h(self)
         for h in list(self._bpy.app.handlers.frame_change_post):
             h(self)
+
+    def ray_cast(self, view_layer, origin, direction, distance=None):
+        hit = self.ray_cast_target is not None
+        return (hit, None, None, None, self.ray_cast_target, None)
 
 
 class _Region:
@@ -61,7 +363,8 @@ class _SpaceData:
     type = "VIEW_3D"
 
     def __init__(self):
-        pass
+        self.shading = types.SimpleNamespace(type="SOLID")
+        self.overlay = types.SimpleNamespace(show_overlays=True)
 
 
 class _Area:
@@ -107,6 +410,10 @@ class _Ops:
         self._bpy._animation_running = False
 
 
+class _PropCollection(list):
+    """Stands in for ``bpy.types.bpy_prop_collection`` (scene_stats)."""
+
+
 class FakeBpy(types.ModuleType):
     """Install with ``install()`` before importing blendjax.btb.animation."""
 
@@ -119,8 +426,16 @@ class FakeBpy(types.ModuleType):
             scene=scene,
             screen=_Screen(space),
             space_data=space,
+            view_layer=types.SimpleNamespace(name="ViewLayer"),
+            evaluated_depsgraph_get=lambda: "<depsgraph>",
         )
-        self.types = types.SimpleNamespace(SpaceView3D=_SpaceView3DType)
+        self.types = types.SimpleNamespace(
+            SpaceView3D=_SpaceView3DType,
+            bpy_prop_collection=_PropCollection,
+        )
+        self.data = types.SimpleNamespace(
+            objects=_PropCollection(), meshes=_PropCollection()
+        )
         self.ops = _Ops(self)
         self._animation_running = False
         _SpaceView3DType._handlers = []
@@ -149,10 +464,39 @@ class FakeBpy(types.ModuleType):
 
 
 def install():
-    """Install a fresh FakeBpy into sys.modules and purge cached blendjax
-    modules that bound the previous instance.  Returns the fake."""
+    """Install a fresh FakeBpy (plus ``gpu``/``gpu_extras``/``mathutils``)
+    into sys.modules and purge cached blendjax modules that bound the
+    previous instance.  Returns the fake bpy."""
     fake = FakeBpy()
     sys.modules["bpy"] = fake
-    for name in ("blendjax.btb.animation", "blendjax.btb.utils", "blendjax.btb.camera"):
+
+    gpu_mod = types.ModuleType("gpu")
+    gpu_mod.types = types.SimpleNamespace(GPUOffScreen=FakeGPUOffScreen)
+    sys.modules["gpu"] = gpu_mod
+
+    gpu_extras = types.ModuleType("gpu_extras")
+    presets = types.ModuleType("gpu_extras.presets")
+    presets.draw_texture_2d = lambda *a, **k: None
+    gpu_extras.presets = presets
+    sys.modules["gpu_extras"] = gpu_extras
+    sys.modules["gpu_extras.presets"] = presets
+
+    mathutils = types.ModuleType("mathutils")
+    mathutils.Matrix = Matrix
+    mathutils.Vector = Vector
+    sys.modules["mathutils"] = mathutils
+
+    for name in (
+        "blendjax.btb.animation",
+        "blendjax.btb.utils",
+        "blendjax.btb.camera",
+        "blendjax.btb.offscreen",
+    ):
         sys.modules.pop(name, None)
+        # also drop the attribute from the parent package: ``from
+        # blendjax.btb import utils`` short-circuits on an existing
+        # attribute and would hand back the module bound to a stale fake
+        pkg = sys.modules.get("blendjax.btb")
+        if pkg is not None and hasattr(pkg, name.rsplit(".", 1)[1]):
+            delattr(pkg, name.rsplit(".", 1)[1])
     return fake

@@ -440,3 +440,51 @@ def test_item_override_disables_batched_stream():
         int(v) for b in batches for v in np.asarray(b["frameid"]).ravel()
     )
     assert got == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_reader_survives_producer_respawn():
+    """Generation change: a respawned producer's bjr_create unlinks and
+    recreates the ring; the reader must drain the old generation's buffered
+    records, detect the identity change, and remap the new ring
+    (VERDICT r01 weak #6)."""
+    addr = _addr("gen")
+    w_a = nring.ShmRingWriter(addr, capacity_bytes=1 << 16)
+    r = nring.ShmRingReader(addr)
+    assert w_a.send_frames([b"a0"]) and w_a.send_frames([b"a1"])
+    assert r.recv_frames(1000) == [b"a0"]
+    # producer "crashes" (never calls close -> producer_closed stays 0)
+    # and is respawned under the same address
+    w_b = nring.ShmRingWriter(addr, capacity_bytes=1 << 16)
+    assert w_b.send_frames([b"b0"])
+    # old generation drains first; then the reader reopens transparently
+    assert r.recv_frames(5000) == [b"a1"]
+    assert r.recv_frames(5000) == [b"b0"]
+    assert r.reconnects == 1
+    r.close()
+    w_b.close(unlink=True)
+    w_a.close(unlink=False)  # stale mapping cleanup, nothing to unlink
+
+
+def test_reader_raises_when_ring_gone_for_good():
+    """Producer crashed and nothing respawned it: the reader must fail
+    with a distinguishable error within the timeout, not hang."""
+    addr = _addr("gone")
+    w = nring.ShmRingWriter(addr, capacity_bytes=1 << 14)
+    r = nring.ShmRingReader(addr)
+    nring.unlink_address(addr)
+    with pytest.raises(ConnectionResetError, match="vanished"):
+        r.recv_frames(1200)
+    r.close()
+    w.close(unlink=False)
+
+
+def test_reader_auto_reopen_disabled():
+    addr = _addr("noreopen")
+    w_a = nring.ShmRingWriter(addr, capacity_bytes=1 << 14)
+    r = nring.ShmRingReader(addr, auto_reopen=False)
+    w_b = nring.ShmRingWriter(addr, capacity_bytes=1 << 14)
+    with pytest.raises(ConnectionResetError):
+        r.recv_frames(1200)
+    r.close()
+    w_b.close(unlink=True)
+    w_a.close(unlink=False)

@@ -73,3 +73,53 @@ def test_restart_respawns_instance(fake_blender):
             msgs = _drain(bl.launch_info.addresses["DATA"], 1)
             assert msgs[0]["btid"] == 0
             assert wd.deaths and wd.deaths[0][2] is True
+
+
+def test_restart_heals_shm_stream(fake_blender):
+    """Crash injection on the shm transport: SIGKILL the producer (ring
+    lingers, producer_closed never set), watchdog respawns it (recreating
+    the ring under the same name), and the consumer's stream heals
+    transparently via the reader's generation reopen (VERDICT r01 #6)."""
+    import os
+    import signal
+
+    from blendjax.native import ring as nring
+
+    if not nring.native_available():
+        pytest.skip("native ring not built")
+
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    with BlenderLauncher(
+        scene="",
+        script=f"{BLEND_SCRIPTS}/stream.blend.py",
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12700,
+        proto="shm",
+        background=True,
+    ) as bl:
+        addr = bl.launch_info.addresses["DATA"][0]
+        assert addr.startswith("shm://")
+        with FleetWatchdog(bl, interval=0.2, restart=True) as wd:
+            ds = RemoteIterableDataset([addr], max_items=10**9, timeoutms=30000)
+            it = ds.stream()
+            first = [next(it) for _ in range(5)]
+            assert [m["frameid"] for m in first] == [0, 1, 2, 3, 4]
+
+            proc = bl.launch_info.processes[0]
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+
+            # keep consuming across the crash: old-generation items may
+            # drain first, then the respawned producer restarts at 0
+            seen_restart = False
+            for _ in range(2000):
+                msg = next(it)
+                if msg["frameid"] == 0:
+                    seen_restart = True
+                    break
+            assert seen_restart
+            assert next(it)["frameid"] == 1
+            assert wd.deaths and wd.deaths[0][2] is True
+        # unwind the iterator before the launcher tears down
+        it.close()
