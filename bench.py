@@ -113,18 +113,18 @@ def run_child_collect_json(cmd, env, deadline_s):
         except OSError:
             proc.kill()
         proc.wait(timeout=10)
-        _sweep_shm()  # killed producers never unlink their rings
+        _sweep_shm(proc.pid)  # killed producers never unlink their rings
     t.join(timeout=5)
     return lines
 
 
-def _sweep_shm():
-    """Remove shm rings leaked by SIGKILLed suite runs (the producers'
-    unlink path never runs under killpg); names are pid-unique so each
-    killed run would otherwise strand ~64 MiB per producer in /dev/shm."""
+def _sweep_shm(child_pid):
+    """Remove shm rings leaked by THIS run's SIGKILLed suite child (the
+    producers' unlink path never runs under killpg); names embed the suite
+    child's pid, so the sweep can't touch a concurrently running suite."""
     import glob
 
-    for path in glob.glob("/dev/shm/bjx-suite-*"):
+    for path in glob.glob(f"/dev/shm/bjx-suite-*-{child_pid}-*"):
         try:
             os.unlink(path)
         except OSError:

@@ -207,6 +207,13 @@ class RemoteIterableDataset:
                         readers.remove(reader)
                         block_ms = 100 if len(readers) == 1 else 0
                         continue
+                    except ConnectionResetError:
+                        # ring vanished and the producer isn't back within
+                        # this slice; the reader stays retryable, so keep
+                        # rotating until the dataset timeout expires (the
+                        # watchdog respawn may land any moment)
+                        waited_ms += max(block_ms, 0)
+                        continue
                     if res is None:
                         waited_ms += max(block_ms, 0)
                         continue

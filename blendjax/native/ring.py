@@ -259,42 +259,59 @@ class ShmRingReader:
         self._lib = lib
         self._name = shm_name_from_address(address)
         self._auto_reopen = auto_reopen
+        self._open_timeout_ms = open_timeout_ms
         self.reconnects = 0
         self._h = lib.bjr_open(self._name.encode(), open_timeout_ms)
         if not self._h:
             raise OSError(f"failed to open shm ring {self._name}")
 
     def _acquire(self, data, length, timeout_ms):
-        """read_acquire with vanished-ring reopen inside the deadline."""
+        """read_acquire with vanished-ring reopen inside the deadline.
+
+        ``timeout_ms < 0`` means wait forever (matching the C layer's
+        convention): reopen attempts then loop on ``open_timeout_ms``
+        slices with no deadline.  After a failed reopen the reader stays
+        retryable — ``_h`` is None and the next call resumes the reopen
+        instead of dereferencing a dead handle.
+        """
         import time
 
-        deadline = time.monotonic() + max(timeout_ms, 0) / 1e3
+        infinite = timeout_ms < 0
+        deadline = None if infinite else time.monotonic() + timeout_ms / 1e3
+
+        def remaining_ms():
+            return -1 if infinite else int((deadline - time.monotonic()) * 1e3)
+
         while True:
+            if self._h is None:
+                # a previous generation vanished; always make at least one
+                # (possibly non-blocking) reopen attempt — the timeout-0
+                # rotation path heals exactly this way, one attempt per
+                # sweep until the respawned producer's ring appears
+                wait = self._open_timeout_ms if infinite else max(remaining_ms(), 0)
+                h = self._lib.bjr_open(self._name.encode(), wait)
+                if not h:
+                    if infinite:
+                        continue
+                    raise ConnectionResetError(
+                        f"shm ring {self._name} vanished; reopen timed out"
+                    )
+                self._h = h
+                self.reconnects += 1
             rc = self._lib.bjr_read_acquire(
-                self._h, ctypes.byref(data), ctypes.byref(length), timeout_ms
+                self._h,
+                ctypes.byref(data),
+                ctypes.byref(length),
+                -1 if infinite else max(remaining_ms(), 0),
             )
             if rc != -4:
                 return rc
-            remaining_ms = int((deadline - time.monotonic()) * 1e3)
             if not self._auto_reopen:
                 raise ConnectionResetError(
                     f"shm ring {self._name} vanished (producer died)"
                 )
             self._lib.bjr_close(self._h, 0)
             self._h = None
-            if remaining_ms <= 0:
-                raise ConnectionResetError(
-                    f"shm ring {self._name} vanished; producer not back "
-                    f"within the timeout"
-                )
-            h = self._lib.bjr_open(self._name.encode(), remaining_ms)
-            if not h:
-                raise ConnectionResetError(
-                    f"shm ring {self._name} vanished; reopen timed out"
-                )
-            self._h = h
-            self.reconnects += 1
-            timeout_ms = max(int((deadline - time.monotonic()) * 1e3), 0)
 
     def recv_frames(self, timeout_ms):
         """Next framed message as a list of buffer-like frames, or None on
@@ -343,24 +360,35 @@ class ShmRingReader:
 
     def release_record(self):
         """Release the record handed out by :meth:`recv_frames_view`."""
-        self._lib.bjr_read_release(self._h)
+        if self._h is not None:
+            self._lib.bjr_read_release(self._h)
 
     def pending_bytes(self):
-        return self._lib.bjr_pending(self._h)
+        # _h is None between a failed reopen and the next recv retry; a
+        # dead generation has nothing pending
+        return 0 if self._h is None else self._lib.bjr_pending(self._h)
 
     def close(self, unlink=False):
         if self._h:
             self._lib.bjr_close(self._h, int(unlink))
             self._h = None
+        elif unlink:
+            # handle already gone (failed reopen); still honor the unlink
+            _unlink_name(self._name)
+
+
+def _unlink_name(name):
+    """Best-effort removal of a shm object by name (POSIX shm objects live
+    under /dev/shm on Linux)."""
+    try:
+        os.unlink(os.path.join("/dev/shm", name.lstrip("/")))
+    except OSError:
+        pass
 
 
 def unlink_address(address):
     """Best-effort removal of a ring's shm backing file."""
-    name = shm_name_from_address(address).lstrip("/")
-    try:
-        os.unlink(os.path.join("/dev/shm", name))
-    except OSError:
-        pass
+    _unlink_name(shm_name_from_address(address))
 
 
 def copy_into(dst, src):

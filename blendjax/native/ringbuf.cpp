@@ -49,6 +49,8 @@ struct Handle {
   char name[256];
   int owner;          // created (vs opened)
   uint64_t last_rec;  // bytes to release after read_acquire
+  uint64_t next_vanish_check_ms;  // rate-limits bjr_vanished's syscalls
+                                  // across timeout-0 polls (hot rotation)
   dev_t st_dev;       // identity of the mapped shm object: a respawned
   ino_t st_ino;       // producer's bjr_create makes a NEW object under the
                       // same name; the reader detects the inode change
@@ -277,7 +279,20 @@ int bjr_read_acquire(void* handle, const void** data, uint64_t* len,
       return 0;
     }
     if (hdr->producer_closed.load(std::memory_order_acquire)) return -3;
-    if (timeout_ms >= 0 && now_ms() >= deadline) return -1;
+    if (timeout_ms >= 0 && now_ms() >= deadline) {
+      // Vanish must be detectable even at timeout_ms == 0: the multi-ring
+      // rotation polls with 0 and would otherwise never learn that a
+      // respawned producer recreated the ring (stale mapping polled
+      // forever, returning -1 until the dataset times out).  The check is
+      // rate-limited via the handle (~50 ms cadence) so steady-state idle
+      // polls don't pay shm_open+fstat per call; healing latency stays
+      // bounded at the cadence.
+      if (!h->owner && now_ms() >= h->next_vanish_check_ms) {
+        h->next_vanish_check_ms = now_ms() + 50;
+        if (bjr_vanished(handle)) return -4;
+      }
+      return -1;
+    }
     if (!h->owner && now_ms() >= next_vanish_check) {
       if (bjr_vanished(handle)) return -4;
       next_vanish_check = now_ms() + 50;
