@@ -49,6 +49,7 @@ def run(args):
         background=True,
         timeoutms=30000,
         horizon=1_000_000_000,  # episodes never end inside the window
+        physics_us=args.physics_us,
     ) as pool:
         pool.reset()
         actions = [0.5] * args.instances
@@ -69,6 +70,11 @@ def run(args):
         "instances": args.instances,
         "per_env_hz": round(n / dt, 1),
         "vs_baseline": round(steps_per_sec / REFERENCE_HZ, 3),
+        # the reference's ~2000 Hz rides a near-free cartpole sim; this
+        # harness's env is free unless --physics-us adds a per-frame
+        # busy-wait standing in for a solver tick
+        "includes_physics": args.physics_us > 0,
+        "physics_us": args.physics_us,
     }
 
 
@@ -76,6 +82,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--instances", type=int, default=4)
     ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument(
+        "--physics-us", type=int, default=0,
+        help="busy-wait per env step, simulating physics solver cost",
+    )
     args = ap.parse_args(argv)
     print(json.dumps(run(args)))
 

@@ -162,6 +162,13 @@ class JaxStream:
     def __len__(self):
         return len(self.loader)
 
+    def duty_cycle(self, name):
+        """Fraction of wall time (since the timer's last reset) spent in
+        stage ``name`` — e.g. ``duty_cycle('device_put')`` for the feed's
+        transfer share, or a caller-recorded ``'step'`` stage for train
+        duty cycle.  Delegates to :meth:`StageTimer.duty_cycle`."""
+        return self.timer.duty_cycle(name)
+
     def __iter__(self):
         return device_prefetch(
             iter(self.loader),

@@ -19,17 +19,26 @@ from blendjax.btb.env import BaseEnv, RemoteControlledAgent  # noqa: E402
 
 class EchoEnv(BaseEnv):
     """obs == last applied action; reward == action / 10; episode horizon
-    set by the frame range."""
+    set by the frame range.  ``physics_us > 0`` busy-waits that long per
+    applied step, standing in for a physics solver's per-frame cost (the
+    RL benchmark's ``includes_physics`` configuration)."""
 
-    def __init__(self, agent):
+    def __init__(self, agent, physics_us=0):
         super().__init__(agent)
         self.applied = 0.0
+        self.physics_us = physics_us
 
     def _env_reset(self):
         self.applied = 0.0
 
     def _env_prepare_step(self, action):
         self.applied = float(action)
+        if self.physics_us > 0:
+            import time
+
+            end = time.perf_counter() + self.physics_us / 1e6
+            while time.perf_counter() < end:
+                pass
 
     def _env_post_step(self):
         return {
@@ -43,10 +52,11 @@ def main():
     btargs, remainder = parse_blendtorch_args()
     parser = argparse.ArgumentParser()
     parser.add_argument("--horizon", type=int, default=10)
+    parser.add_argument("--physics-us", type=int, default=0)
     args = parser.parse_args(remainder)
 
     agent = RemoteControlledAgent(btargs.btsockets["GYM"], timeoutms=30000)
-    env = EchoEnv(agent)
+    env = EchoEnv(agent, physics_us=args.physics_us)
     env.run(frame_range=(1, args.horizon), use_animation=False)
 
 
