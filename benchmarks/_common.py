@@ -1,0 +1,84 @@
+"""Shared benchmark-harness helpers.
+
+One copy of the budget tracker, producer-fleet handle, and producer
+launcher used by both ``suite.py`` (jax-free parent) and
+``suite_device.py`` (accelerator child).  The shm ring-name scheme lives
+HERE and only here: ``bjx-suite-{tag}-{nonce}-{i}``, where ``nonce``
+embeds the orchestrating process's pid so ``bench.py``'s leak sweep
+(``/dev/shm/bjx-suite-*-{pid}-*``) finds every ring either child created.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def note(msg, who="suite"):
+    print(f"[{who}] {msg}", file=sys.stderr, flush=True)
+
+
+class Budget:
+    def __init__(self, total_s, who="suite"):
+        self.t0 = time.monotonic()
+        self.total = total_s
+        self.who = who
+
+    def remaining(self):
+        return self.total - (time.monotonic() - self.t0)
+
+    def has(self, seconds, what):
+        if self.remaining() >= seconds:
+            return True
+        note(
+            f"skipping {what}: {self.remaining():.0f}s left < {seconds:.0f}s",
+            self.who,
+        )
+        return False
+
+
+class Producers:
+    """Handle over a launched synthetic-producer fleet."""
+
+    def __init__(self, addrs, procs, transport):
+        self.addrs = addrs
+        self.procs = procs
+        self.transport = transport
+
+    def close(self):
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if self.transport == "shm":
+            from blendjax.native import unlink_address
+
+            for a in self.addrs:
+                unlink_address(a)
+
+
+def launch_fleet(n, extra, tag, *, transport, raw, ring_nonce, env):
+    """Spawn ``n`` ``stream_producer.py`` processes; returns Producers."""
+    from benchmarks.benchmark import free_port
+
+    addrs, procs = [], []
+    for i in range(n):
+        if transport == "shm":
+            addr = f"shm://bjx-suite-{tag}-{ring_nonce}-{i}"
+        else:
+            addr = f"tcp://127.0.0.1:{free_port()}"
+        cmd = [
+            sys.executable,
+            os.path.join(HERE, "stream_producer.py"),
+            "--addr", addr, "--btid", str(i),
+        ] + extra + (["--raw"] if raw else [])
+        procs.append(subprocess.Popen(cmd, env=env))
+        addrs.append(addr)
+    return Producers(addrs, procs, transport)

@@ -42,6 +42,10 @@ def run(args):
     )
     script = os.path.join(os.path.dirname(HERE), "tests", "blender", "env.blend.py")
 
+    # randomized port base: back-to-back benchmark children (e.g. the
+    # no-physics and with-physics configurations) must not collide on the
+    # launcher's default 11000 while lingering sockets drain
+    start_port = 20000 + (os.getpid() * 37) % 20000
     with launch_env_pool(
         scene="",
         script=script,
@@ -50,6 +54,7 @@ def run(args):
         timeoutms=30000,
         horizon=1_000_000_000,  # episodes never end inside the window
         physics_us=args.physics_us,
+        start_port=start_port,
     ) as pool:
         pool.reset()
         actions = [0.5] * args.instances

@@ -37,6 +37,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 if os.path.dirname(HERE) not in sys.path:
     sys.path.insert(0, os.path.dirname(HERE))
 
+from benchmarks._common import Budget, launch_fleet  # noqa: E402
+
 # bf16 peak TFLOP/s per chip, from published TPU specs; device_kind
 # substrings as reported by jax.devices()[0].device_kind.
 PEAK_BF16_TFLOPS = (
@@ -63,7 +65,9 @@ def emit(obj):
 
 
 def note(msg):
-    print(f"[suite-device] {msg}", file=sys.stderr, flush=True)
+    from benchmarks._common import note as _note
+
+    _note(msg, who="suite-device")
 
 
 def peak_flops():
@@ -96,21 +100,6 @@ def step_flops(jitted, budget, *example_args):
     except Exception as e:  # noqa: BLE001 - cost model is best-effort
         note(f"cost_analysis unavailable: {e}")
         return None
-
-
-class Budget:
-    def __init__(self, total_s):
-        self.t0 = time.monotonic()
-        self.total = total_s
-
-    def remaining(self):
-        return self.total - (time.monotonic() - self.t0)
-
-    def has(self, seconds, what):
-        if self.remaining() >= seconds:
-            return True
-        note(f"skipping {what}: {self.remaining():.0f}s left < {seconds:.0f}s")
-        return False
 
 
 def _measure_stream(stream, window_s, warmup_batches, batch_size,
@@ -213,11 +202,14 @@ def phase_cube_stream(args, budget, producers, tag):
         )
 
     # -- phase 1: stream -> HBM ------------------------------------------
-    if budget.has(40, "stream_to_hbm"):
+    # Windows shrink when the budget is thin (e.g. slow backend init ate
+    # most of it): a 3 s TPU-fed window beats a skipped phase.
+    hbm_window = min(args.hbm_seconds, max(3.0, budget.remaining() * 0.15))
+    if budget.has(hbm_window + 15, "stream_to_hbm"):
         stream = make_stream()
         try:
             res, _ = _measure_stream(
-                stream, args.hbm_seconds, warmup_batches=2,
+                stream, hbm_window, warmup_batches=2,
                 batch_size=args.batch,
             )
             res.update(phase="stream_to_hbm", stages=stream.timer.summary(),
@@ -227,7 +219,8 @@ def phase_cube_stream(args, budget, producers, tag):
             stream.close()
 
     # -- phase 2: stream -> detector train -------------------------------
-    if not budget.has(60, "stream_to_train"):
+    train_window = min(args.train_seconds, max(4.0, budget.remaining() * 0.2))
+    if not budget.has(train_window + 30, "stream_to_train"):
         return
     opt = optax.adam(1e-3)
     params = detector.init(
@@ -259,7 +252,7 @@ def phase_cube_stream(args, budget, producers, tag):
     stream = make_stream()
     try:
         res, state = _measure_stream(
-            stream, args.train_seconds, warmup_batches=2,
+            stream, train_window, warmup_batches=2,
             batch_size=args.batch, train_step=train_step, state=state,
             step_s=step_s, max_inflight=args.max_inflight,
         )
@@ -304,7 +297,7 @@ def phase_seqformer(args, budget, launch, tag):
         args.seq_instances,
         ["--mode", "episode", "--seq-len", str(args.seq_len),
          "--obs-dim", str(args.obs_dim)],
-        tag="seq",
+        tag_name="seq",
     )
     try:
         params = seqformer.init(jax.random.PRNGKey(0), **kwargs)
@@ -410,15 +403,19 @@ def phase_moe_compare(args, budget, tag):
             out[variant] = {"skipped": True}
             continue
         vkw = dict(kwargs)
+        loss = seqformer.loss_fn
         if variant == "topk":
-            vkw.update(
-                moe_experts=args.moe_experts,
-                moe_top_k=args.moe_topk,
+            import functools
+
+            vkw["n_experts"] = args.moe_experts
+            loss = functools.partial(
+                seqformer.loss_fn, moe_impl="topk", moe_k=args.moe_topk,
+                moe_aux_weight=0.01,
             )
         params = seqformer.init(jax.random.PRNGKey(0), **vkw)
         opt = optax.adam(1e-4)
         state = TrainState.create(params, opt)
-        train_step = make_train_step(seqformer.loss_fn, opt)
+        train_step = make_train_step(loss, opt)
         tC = time.perf_counter()
         try:
             step_s, state = _pure_step_time(train_step, state, warm_dev)
@@ -449,38 +446,20 @@ def phase_moe_compare(args, budget, tag):
     emit(out)
 
 
-class _Producers:
-    def __init__(self, addrs, procs, transport):
-        self.addrs = addrs
-        self.procs = procs
-        self.transport = transport
-
-    def close(self):
-        import subprocess
-
-        for p in self.procs:
-            p.terminate()
-        for p in self.procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        if self.transport == "shm":
-            from blendjax.native import unlink_address
-
-            for a in self.addrs:
-                unlink_address(a)
-
-
 def apply_config(args):
     """--config small shrinks the MXU-bound sizes so a CPU child still
-    runs real streaming windows (methodology validation, not peak perf)."""
+    runs real streaming windows (methodology validation, not peak perf).
+    Cube frames shrink too — a 640x480 detector step takes seconds on one
+    CPU core and would eat the fallback child's whole budget; emitted
+    phases carry width/height so the parent labels the metric honestly."""
     if args.config == "small":
         args.seq_len = 129
         args.d_model = 256
         args.n_heads = 4
         args.n_layers = 2
         args.seq_instances = min(args.seq_instances, 2)
+        args.width = 160
+        args.height = 120
     return args
 
 
@@ -517,6 +496,9 @@ def main(argv=None):
     ap.add_argument("--skip-moe", action="store_true")
     ap.add_argument("--moe-experts", type=int, default=8)
     ap.add_argument("--moe-topk", type=int, default=2)
+    ap.add_argument("--ring-nonce", default=str(os.getpid()),
+                    help="embedded in shm ring names; the parent passes its "
+                         "own pid so its leak sweep finds our rings")
     args = apply_config(ap.parse_args(argv))
 
     budget = Budget(args.budget)
@@ -542,7 +524,8 @@ def main(argv=None):
     emit({"phase": "device_init", "seconds": round(init_s, 1),
           "device_kind": dev.device_kind, "platform": dev.platform,
           "config": args.config})
-    tag = {"platform": dev.platform, "config": args.config}
+    tag = {"platform": dev.platform, "config": args.config,
+           "width": args.width, "height": args.height}
 
     from blendjax.btt.launcher import child_env
 
@@ -550,24 +533,10 @@ def main(argv=None):
     env["JAX_PLATFORMS"] = "cpu"  # producers never touch the accelerator
 
     def launch(n, extra, tag_name):
-        import subprocess
-
-        from benchmarks.benchmark import free_port
-
-        addrs, procs = [], []
-        for i in range(n):
-            if args.transport == "shm":
-                addr = f"shm://bjx-suite-{tag_name}-{os.getpid()}-{i}"
-            else:
-                addr = f"tcp://127.0.0.1:{free_port()}"
-            cmd = [
-                sys.executable,
-                os.path.join(HERE, "stream_producer.py"),
-                "--addr", addr, "--btid", str(i),
-            ] + extra + (["--raw"] if args.raw else [])
-            procs.append(subprocess.Popen(cmd, env=env))
-            addrs.append(addr)
-        return _Producers(addrs, procs, args.transport)
+        return launch_fleet(
+            n, extra, tag_name, transport=args.transport, raw=args.raw,
+            ring_nonce=args.ring_nonce, env=env,
+        )
 
     producers = launch(
         args.instances,

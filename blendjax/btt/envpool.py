@@ -147,12 +147,15 @@ def launch_env_pool(
     background=False,
     timeoutms=DEFAULT_TIMEOUTMS,
     autoreset=True,
+    start_port=11000,
     **kwargs,
 ):
     """Launch N Blender env instances and yield a connected EnvPool.
 
     The pool analog of :func:`blendjax.btt.env.launch_env`; extra kwargs
-    become CLI flags for every instance's env script.
+    become CLI flags for every instance's env script.  ``start_port``
+    seeds the per-instance address allocation (pick a distinct base when
+    several pools may run concurrently on one host).
     """
     from blendjax.btt.launcher import BlenderLauncher
 
@@ -163,6 +166,7 @@ def launch_env_pool(
         named_sockets=["GYM"],
         instance_args=[list(kwargs_to_cli(kwargs)) for _ in range(num_instances)],
         background=background,
+        start_port=start_port,
     ) as bl:
         pool = EnvPool(
             bl.launch_info.addresses["GYM"],
