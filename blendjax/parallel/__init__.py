@@ -3,6 +3,7 @@
 from blendjax.parallel.mesh import data_mesh, data_sharding, make_mesh, replicated
 from blendjax.parallel.pipeline import (
     make_pipeline,
+    make_pipeline_train,
     microbatch,
     stack_stage_params,
     unstack_stage_params,
@@ -38,6 +39,7 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "make_pipeline",
+    "make_pipeline_train",
     "microbatch",
     "stack_stage_params",
     "unstack_stage_params",

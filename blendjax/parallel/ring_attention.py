@@ -43,7 +43,16 @@ _NEG = -1e30  # finite mask value: keeps the online-softmax nan-free
 
 def _pvary(x, axes):
     """Mark ``x`` device-varying over ``axes`` under shard_map's vma typing
-    (no-op on JAX versions without the typing)."""
+    (no-op on JAX versions without the typing).  Idempotent: axes the
+    value already varies over are skipped — zeros_like of a sharded input
+    is already varying, and re-casting raises."""
+    try:
+        vma = jax.typeof(x).vma
+        axes = tuple(a for a in axes if a not in vma)
+    except (AttributeError, TypeError):
+        pass
+    if not axes:
+        return x
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axes, to="varying")
     if hasattr(lax, "pvary"):
