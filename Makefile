@@ -1,0 +1,33 @@
+# blendjax developer entry points.
+#
+# `make blender-tests` is the one-command real-Blender acceptance run
+# (VERDICT r2 task #6): on any machine with a Blender binary it needs no
+# edits — discovery walks $PATH (override with $BLENDJAX_REAL_BLENDER);
+# headless hosts get a GL context via scripts/blender_headless.sh.
+
+PYTHON ?= python
+
+.PHONY: test blender-tests bench dryrun
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Real-Blender acceptance subset (camera goldens, producer streaming,
+# cartpole physics).  Skips cleanly when no Blender is discoverable.
+# On a headless host (e.g. a TPU-VM) route Blender through the virtual
+# display wrapper so Eevee gets a GL context:
+#   make blender-tests BLENDER_WRAPPER=1
+blender-tests:
+ifdef BLENDER_WRAPPER
+	BLENDJAX_BLENDER=$(CURDIR)/scripts/blender_headless.sh \
+		$(PYTHON) -m pytest tests/ -m blender -q -rs
+else
+	$(PYTHON) -m pytest tests/ -m blender -q -rs
+endif
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PYTHON) __graft_entry__.py

@@ -499,7 +499,17 @@ def main(argv=None):
     ap.add_argument("--ring-nonce", default=str(os.getpid()),
                     help="embedded in shm ring names; the parent passes its "
                          "own pid so its leak sweep finds our rings")
+    ap.add_argument("--gil-switch-us", type=int, default=500,
+                    help="sys.setswitchinterval for this process, in "
+                         "microseconds (0 keeps the 5 ms default). On a "
+                         "1-core host the tunnel client's transfer chunks "
+                         "wait for the GIL behind collate/recv threads; "
+                         "measured on this image: a single concurrent "
+                         "numpy thread collapses device_put bandwidth "
+                         "~6x at the default interval")
     args = apply_config(ap.parse_args(argv))
+    if args.gil_switch_us > 0:
+        sys.setswitchinterval(args.gil_switch_us / 1e6)
 
     budget = Budget(args.budget)
     global _SUFFIX

@@ -1,0 +1,78 @@
+"""Real-Blender producer for the golden-camera acceptance test.
+
+Builds the deterministic scene described by ``golden_camera_spec.py``
+inside a REAL Blender (procedural — no .blend asset), projects the spec's
+world points through the bpy ``Camera`` adapter (real ``matrix_world`` +
+``calc_matrix_camera`` on the evaluated depsgraph) for a perspective and
+an orthographic camera, and publishes the resulting pixel coordinates and
+depths once.  The consumer test compares them against the analytic values
+from :mod:`blendjax.btb.camera_math` — the reference's golden camera bar
+(``tests/test_camera.py:10-49``) without the checked-in scene file.
+"""
+
+import importlib.util
+import os
+import sys
+
+import bpy
+import numpy as np
+
+from blendjax import btb
+
+_SPEC_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden_camera_spec.py")
+_spec_mod = importlib.util.spec_from_file_location("golden_camera_spec",
+                                                   _SPEC_PATH)
+spec = importlib.util.module_from_spec(_spec_mod)
+_spec_mod.loader.exec_module(spec)
+
+
+def _clear_scene():
+    bpy.ops.object.select_all(action="SELECT")
+    bpy.ops.object.delete(use_global=False)
+
+
+def _add_camera(name, cam_type):
+    data = bpy.data.cameras.new(name)
+    data.type = cam_type
+    data.clip_start = spec.NEAR
+    data.clip_end = spec.FAR
+    if cam_type == "ORTHO":
+        data.ortho_scale = spec.ORTHO_SCALE
+    else:
+        data.sensor_fit = "AUTO"
+        data.angle = spec.FOV_X  # horizontal FOV at AUTO fit, w >= h
+    obj = bpy.data.objects.new(name, data)
+    bpy.context.scene.collection.objects.link(obj)
+    return obj
+
+
+def main():
+    args, _ = btb.parse_blendtorch_args(sys.argv)
+
+    _clear_scene()
+    scene = bpy.context.scene
+    scene.render.resolution_x = spec.WIDTH
+    scene.render.resolution_y = spec.HEIGHT
+    scene.render.resolution_percentage = 100
+
+    payload = {}
+    for name, cam_type in (("persp", "PERSP"), ("ortho", "ORTHO")):
+        obj = _add_camera(name, cam_type)
+        scene.camera = obj
+        cam = btb.Camera(obj)
+        cam.look_at(look_at=spec.TARGET, look_from=spec.EYE)
+        bpy.context.view_layer.update()
+        cam.update_view_matrix()
+        cam.update_proj_matrix()
+        ndc, depth = cam.world_to_ndc(spec.POINTS, return_depth=True)
+        pix = cam.ndc_to_pixel(ndc, origin="upper-left")
+        payload[f"{name}_pix"] = np.asarray(pix, np.float64)
+        payload[f"{name}_depth"] = np.asarray(depth, np.float64)
+        payload[f"{name}_type"] = cam.type
+
+    pub = btb.DataPublisher(args.btsockets["DATA"], args.btid)
+    pub.publish(**payload)
+
+
+main()

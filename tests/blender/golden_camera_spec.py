@@ -1,0 +1,88 @@
+"""Shared spec for the real-Blender golden-camera acceptance test.
+
+One source of truth for the deterministic camera setups used by BOTH the
+producer running inside real Blender (``golden_camera.blend.py``) and the
+host-side test (``test_blender_integration.py``).  Ports the reference's
+acceptance bar — golden ortho + perspective pixel coordinates and depths
+against a known scene (reference ``tests/test_camera.py:10-49``, scene
+``cam.blend``) — except the scene is built procedurally, so no binary
+asset is required.
+
+The expected values are computed analytically with
+:mod:`blendjax.btb.camera_math`; the real-Blender run validates the bpy
+adapter (``matrix_world`` inversion + ``calc_matrix_camera`` on the
+evaluated depsgraph) against this math to ``ATOL`` pixels, exactly the
+tolerance class the reference used (``atol=1e-2``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+WIDTH, HEIGHT = 640, 480
+ASPECT = WIDTH / HEIGHT
+
+# 2x2x2 cube centered at the origin: its 8 corners are the test points.
+POINTS = np.array(
+    [
+        (x, y, z)
+        for x in (-1.0, 1.0)
+        for y in (-1.0, 1.0)
+        for z in (-1.0, 1.0)
+    ],
+    dtype=np.float64,
+)
+
+EYE = (6.0, -6.0, 4.0)
+TARGET = (0.0, 0.0, 0.0)
+
+# bpy `camera.data.angle` is the HORIZONTAL field of view at AUTO sensor
+# fit with width >= height.
+FOV_X = 0.9  # radians
+NEAR, FAR = 0.1, 100.0
+
+ORTHO_SCALE = 6.0  # bpy ortho_scale: full width of the view volume
+
+ATOL_PIX = 1e-2
+ATOL_DEPTH = 1e-4
+
+
+def check_payload(msg):
+    """Assert a producer payload matches the analytic expectations — the
+    single acceptance bar shared by the CI (fake-bpy) and real-Blender
+    tests so the two cannot drift."""
+    assert msg["persp_type"] == "PERSP"
+    assert msg["ortho_type"] == "ORTHO"
+    exp = expected()
+    for name in ("persp", "ortho"):
+        want_pix, want_depth = exp[name]
+        np.testing.assert_allclose(
+            np.asarray(msg[f"{name}_pix"]), want_pix, atol=ATOL_PIX,
+            err_msg=f"{name} pixel projection drifted from camera_math",
+        )
+        np.testing.assert_allclose(
+            np.asarray(msg[f"{name}_depth"]), want_depth, atol=ATOL_DEPTH,
+            err_msg=f"{name} depth drifted from camera_math",
+        )
+        pix = np.asarray(msg[f"{name}_pix"])
+        assert (pix[:, 0] > 0).all() and (pix[:, 0] < WIDTH).all()
+        assert (pix[:, 1] > 0).all() and (pix[:, 1] < HEIGHT).all()
+
+
+def expected():
+    """Analytic (pixel, depth) for the perspective and ortho cameras."""
+    from blendjax.btb import camera_math as cm
+
+    view = cm.look_at_matrix(EYE, TARGET)
+    fov_y = 2.0 * math.atan(math.tan(FOV_X / 2.0) * HEIGHT / WIDTH)
+    persp = cm.perspective_projection(fov_y, ASPECT, NEAR, FAR)
+    ortho = cm.orthographic_projection(ORTHO_SCALE, ASPECT, NEAR, FAR)
+
+    out = {}
+    for name, proj in (("persp", persp), ("ortho", ortho)):
+        ndc, depth = cm.world_to_ndc(POINTS, view, proj, return_depth=True)
+        pix = cm.ndc_to_pixel(ndc, (HEIGHT, WIDTH), origin="upper-left")
+        out[name] = (np.asarray(pix), np.asarray(depth))
+    return out

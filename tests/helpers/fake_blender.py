@@ -13,6 +13,7 @@ testable without Blender):
   interpreter does, so ``parse_blendtorch_args`` sees the real protocol.
 """
 
+import os
 import runpy
 import sys
 
@@ -35,6 +36,13 @@ def main():
 
     # Blender exposes its own full argv to embedded scripts.
     sys.argv = ["blender"] + argv[1:]
+    if os.environ.get("BLENDJAX_FAKE_BPY"):
+        # producer scripts that import bpy (camera/offscreen paths) run in
+        # CI against the fake module; real Blender provides the real one
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fake_bpy
+
+        fake_bpy.install()
     try:
         runpy.run_path(script, run_name="__main__")
     except SystemExit as e:

@@ -101,16 +101,19 @@ def _euler_xyz_from_rotmat(r):
 
 class _TrackQuat:
     """Result of ``Vector.to_track_quat('-Z', 'Y')``: rotation taking the
-    -Z axis onto the direction, +Y as up reference (Blender cameras look
-    down -Z)."""
+    -Z axis onto the direction with the local +Y (the chosen up axis)
+    oriented toward WORLD +Z — mathutils' Track-To semantics, which keep
+    a camera upright.  (An earlier version referenced world +Y here; the
+    golden-camera acceptance test caught the roll mismatch against real
+    Blender's convention and camera_math.look_at_matrix.)"""
 
     def __init__(self, direction):
         d = np.asarray(direction, float)
         n = np.linalg.norm(d)
         z = -d / n  # camera -Z points along direction
-        y_ref = np.array([0.0, 1.0, 0.0])
-        x = np.cross(y_ref, z)
-        if np.linalg.norm(x) < 1e-8:  # direction parallel to Y
+        up_world = np.array([0.0, 0.0, 1.0])
+        x = np.cross(up_world, z)
+        if np.linalg.norm(x) < 1e-8:  # looking straight up/down
             x = np.array([1.0, 0.0, 0.0])
         x = x / np.linalg.norm(x)
         y = np.cross(z, x)
@@ -180,6 +183,21 @@ class FakeCameraData:
         self.ortho_scale = ortho_scale
         self.clip_start = clip_start
         self.clip_end = clip_end
+        self.sensor_fit = "AUTO"
+
+    @property
+    def angle(self):
+        """Field of view along the sensor-fit axis, like bpy: derived from
+        (and writable through) lens/sensor_width."""
+        import math
+
+        return 2.0 * math.atan(self.sensor_width / (2.0 * self.lens))
+
+    @angle.setter
+    def angle(self, a):
+        import math
+
+        self.lens = self.sensor_width / (2.0 * math.tan(a / 2.0))
 
 
 class FakeCameraObject:
@@ -402,6 +420,11 @@ class _Ops:
         self.screen = types.SimpleNamespace(
             animation_play=self._play, animation_cancel=self._cancel
         )
+        # scene-authoring ops used by procedural producer scripts
+        self.object = types.SimpleNamespace(
+            select_all=lambda action=None: None,
+            delete=lambda use_global=False: self._bpy.data.objects.clear(),
+        )
 
     def _play(self):
         self._bpy._animation_running = True
@@ -433,9 +456,25 @@ class FakeBpy(types.ModuleType):
             SpaceView3D=_SpaceView3DType,
             bpy_prop_collection=_PropCollection,
         )
+        objects = _PropCollection()
+
+        def _new_object(name, data):
+            obj = FakeCameraObject(location=(0.0, 0.0, 0.0), data=data)
+            obj.name = name
+            return obj
+
         self.data = types.SimpleNamespace(
-            objects=_PropCollection(), meshes=_PropCollection()
+            objects=objects,
+            meshes=_PropCollection(),
+            cameras=types.SimpleNamespace(
+                new=lambda name: FakeCameraData()
+            ),
         )
+        self.data.objects.new = _new_object
+        scene.collection = types.SimpleNamespace(
+            objects=types.SimpleNamespace(link=objects.append)
+        )
+        self.context.view_layer.update = lambda: None
         self.ops = _Ops(self)
         self._animation_running = False
         _SpaceView3DType._handlers = []

@@ -1,0 +1,58 @@
+"""Golden-camera acceptance path, CI edition.
+
+Drives the SAME producer script the real-Blender acceptance test uses
+(``tests/blender/golden_camera.blend.py``) through the fake-Blender fleet
+with the fake ``bpy`` installed in the child (``BLENDJAX_FAKE_BPY``), and
+checks the published pixel/depth annotations against the analytic
+expectations of ``golden_camera_spec`` — so the full acceptance plumbing
+(launcher -> embedded script -> bpy adapter -> publisher -> wire) is
+exercised on every CI run; only the ``bpy`` implementation is swapped
+when a real Blender picks it up (``test_blender_integration.py``).
+"""
+
+import importlib.util
+import os
+
+import zmq
+
+from blendjax import wire
+from blendjax.btt.launcher import BlenderLauncher
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SCRIPT = os.path.join(HERE, "blender", "golden_camera.blend.py")
+SPEC = os.path.join(HERE, "blender", "golden_camera_spec.py")
+
+
+def _load_spec():
+    mod_spec = importlib.util.spec_from_file_location("golden_camera_spec", SPEC)
+    mod = importlib.util.module_from_spec(mod_spec)
+    mod_spec.loader.exec_module(mod)
+    return mod
+
+
+def test_golden_camera_producer_matches_analytic(monkeypatch):
+    spec = _load_spec()
+    monkeypatch.setenv(
+        "BLENDJAX_BLENDER",
+        os.path.join(HERE, "helpers", "fake_blender.py"),
+    )
+    monkeypatch.setenv("BLENDJAX_FAKE_BPY", "1")
+
+    with BlenderLauncher(
+        scene="",
+        script=SCRIPT,
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=14730,
+        background=True,
+    ) as bl:
+        ctx = zmq.Context()
+        try:
+            sock = ctx.socket(zmq.PULL)
+            sock.connect(bl.launch_info.addresses["DATA"][0])
+            assert sock.poll(30000), "no golden-camera payload"
+            msg = wire.recv_message(sock)
+        finally:
+            ctx.destroy(linger=0)
+
+    spec.check_payload(msg)

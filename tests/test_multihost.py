@@ -17,6 +17,25 @@ from helpers import producers
 
 HELPERS = os.path.join(os.path.dirname(__file__), "helpers")
 CHILD = os.path.join(HELPERS, "multihost_child.py")
+TRAIN_CHILD = os.path.join(HELPERS, "multihost_train_child.py")
+
+
+def _gather(procs, timeout):
+    """communicate() every child; on ANY failure kill the rest — an
+    orphaned sibling would block on the dead 2-process coordinator
+    barrier and leak into the CI runner."""
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return outs
 
 
 def test_two_process_global_batch_assembly():
@@ -39,11 +58,7 @@ def test_two_process_global_batch_assembly():
             )
             for pid in range(2)
         ]
-        outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=150)
-            assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
+        outs = _gather(procs, timeout=150)
     finally:
         fleet.close()
 
@@ -66,3 +81,40 @@ def test_two_process_global_batch_assembly():
     # the jitted global reduction agrees across processes (same global
     # array on both, assembled from different local halves)
     assert by_pid[0]["mean"] == pytest.approx(by_pid[1]["mean"])
+
+
+def test_two_process_sharded_train_and_checkpoint(tmp_path):
+    """Train side of the multi-host story (VERDICT r2 #5): the same
+    data-parallel train step runs on a 2-process global mesh — each
+    process feeds DIFFERENT local data, so identical losses/params across
+    processes prove the gradient psum crossed the process boundary — and
+    a checkpoint saved by process 0 restores identically on both."""
+    coord = f"localhost:{producers.free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, TRAIN_CHILD, coord, str(pid), "2", str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = _gather(procs, timeout=180)
+
+    by_pid = {o["pid"]: o for o in outs}
+    assert set(by_pid) == {0, 1}
+    # per-process data differs; only a cross-process grad psum makes the
+    # loss (computed on the GLOBAL batch) and updated params agree
+    assert by_pid[0]["losses"] == pytest.approx(by_pid[1]["losses"])
+    assert by_pid[0]["param_mean"] == pytest.approx(by_pid[1]["param_mean"])
+    # training moved the loss
+    assert by_pid[0]["losses"][-1] < by_pid[0]["losses"][0]
+    for o in outs:
+        assert o["restored_equal"], f"pid {o['pid']}: checkpoint round-trip drifted"
+        assert o["restored_step"] == 3
