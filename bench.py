@@ -235,6 +235,8 @@ def main():
             for k in ("dense", "topk", "topk_over_dense", "experts", "top_k")
             if k in moe
         }
+    if host:
+        extras["host_stream_images_per_sec"] = host["items_per_sec"]
     if hbm:
         extras["stream_to_hbm_images_per_sec"] = hbm["items_per_sec"]
     if train:

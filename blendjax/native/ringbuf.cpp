@@ -34,7 +34,12 @@ constexpr uint64_t kMagic = 0x424a5852494e4701ULL;  // "BJXRING" v1
 constexpr uint64_t kWrapMarker = ~0ULL;
 
 struct Header {
-  uint64_t magic;
+  // magic is the header's publication flag: bjr_create stores it with
+  // release ordering AFTER every other field is initialized, and
+  // bjr_open's spin loads it with acquire — otherwise a reader could
+  // observe magic == kMagic while capacity is still 0 (then compute
+  // `pos % 0`, SIGFPE) on a compiler/arch that reorders the plain stores.
+  std::atomic<uint64_t> magic;
   uint64_t capacity;                  // arena size in bytes (multiple of 8)
   std::atomic<uint64_t> head;         // producer: total bytes written
   std::atomic<uint64_t> tail;         // consumer: total bytes consumed
@@ -99,7 +104,7 @@ void* bjr_create(const char* name, uint64_t capacity) {
   hdr->head.store(0, std::memory_order_relaxed);
   hdr->tail.store(0, std::memory_order_relaxed);
   hdr->producer_closed.store(0, std::memory_order_relaxed);
-  hdr->magic = kMagic;  // published last
+  hdr->magic.store(kMagic, std::memory_order_release);  // published last
 
   auto* h = new Handle();
   h->hdr = hdr;
@@ -138,7 +143,9 @@ void* bjr_open(const char* name, int timeout_ms) {
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   auto* hdr = reinterpret_cast<Header*>(mem);
-  while (hdr->magic != kMagic) {  // producer still initializing
+  // acquire pairs with bjr_create's release store: capacity et al. are
+  // fully visible once magic reads kMagic
+  while (hdr->magic.load(std::memory_order_acquire) != kMagic) {
     if (timeout_ms >= 0 && now_ms() >= deadline) {
       munmap(mem, map_size);
       return nullptr;
@@ -335,6 +342,26 @@ void bjr_gather(char* dst, const void* const* srcs, const uint64_t* lens,
     memcpy(dst + off, srcs[i], lens[i]);
     off += lens[i];
   }
+}
+
+// --- test support (tsan_stress.cpp) -----------------------------------
+// Alias a reader Handle onto an EXISTING mapping.  ThreadSanitizer keys
+// its shadow state on virtual addresses: a second mmap of the same shm
+// object would give the reader a disjoint range and hide every
+// cross-thread access pair from the tool, so the stress harness reads
+// through the writer's own mapping.  The alias does not own the mapping
+// — free it with bjr_test_free_alias, never bjr_close.
+void* bjr_test_alias_reader(void* handle) {
+  auto* src = static_cast<Handle*>(handle);
+  auto* h = new Handle(*src);
+  h->owner = 0;
+  h->last_rec = 0;
+  h->next_vanish_check_ms = 0;
+  return h;
+}
+
+void bjr_test_free_alias(void* handle) {
+  delete static_cast<Handle*>(handle);
 }
 
 }  // extern "C"

@@ -1,0 +1,72 @@
+"""Producer child for the ring stress/race harness: publishes
+``{btid, gen, frameid, payload}`` messages as fast as possible until
+killed.  ``gen`` identifies the process generation — the harness SIGKILLs
+producers and respawns them under the SAME address with gen+1, so the
+consumer can assert that no stale-generation frame is ever delivered
+after the reader healed onto the new ring (the round-2 stale-shm
+poisoning class of bug, plus the multi-ring rotation reopen path).
+
+Run: python churn_producer.py --addr shm://... --btid N --gen G [--payload BYTES]
+"""
+
+import argparse
+
+import numpy as np
+
+from blendjax.btb.publisher import DataPublisher
+
+
+def _die_with_parent():
+    """PR_SET_PDEATHSIG=SIGKILL: a hard-killed harness must not leave this
+    full-speed publish loop stealing the CPU from every later run.  Set
+    here (single-threaded, post-exec) — a Popen preexec_fn doing this can
+    deadlock when the parent forks while its other threads hold locks."""
+    import ctypes
+    import signal
+
+    try:
+        ctypes.CDLL(None, use_errno=True).prctl(1, signal.SIGKILL)
+    except Exception:  # non-Linux: best effort only
+        pass
+
+
+def main():
+    _die_with_parent()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--btid", type=int, required=True)
+    ap.add_argument("--gen", type=int, required=True)
+    ap.add_argument("--payload", type=int, default=4096)
+    ap.add_argument("--rate-hz", type=float, default=0.0,
+                    help="throttle publishes; 0 = unthrottled.  The churn "
+                         "harness throttles so the ring never holds many "
+                         "seconds of pre-crash backlog (the reader drains "
+                         "a dead generation's valid frames before healing "
+                         "— no-loss semantics — which at full producer "
+                         "speed hides the respawn for longer than the "
+                         "test window)")
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(args.btid * 1000 + args.gen)
+    # varied sizes exercise the ring's wrap marker + padding paths
+    payloads = [
+        rng.integers(0, 255, size=rng.integers(64, args.payload),
+                     dtype=np.uint8)
+        for _ in range(8)
+    ]
+    import time
+
+    pub = DataPublisher(args.addr, btid=args.btid, raw_buffers=True)
+    period = 1.0 / args.rate_hz if args.rate_hz > 0 else 0.0
+    frameid = 0
+    while True:  # killed by the harness
+        pub.publish(
+            gen=args.gen, frameid=frameid, payload=payloads[frameid % 8]
+        )
+        frameid += 1
+        if period:
+            time.sleep(period)
+
+
+if __name__ == "__main__":
+    main()
