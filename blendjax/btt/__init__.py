@@ -28,6 +28,8 @@ _LAZY = {
     "launch_env": ("blendjax.btt.env", "launch_env"),
     "OpenAIRemoteEnv": ("blendjax.btt.env", "OpenAIRemoteEnv"),
     "EnvPool": ("blendjax.btt.envpool", "EnvPool"),
+    "BlenderVectorEnv": ("blendjax.btt.vector_env", "BlenderVectorEnv"),
+    "launch_vector_env": ("blendjax.btt.vector_env", "launch_vector_env"),
     "FleetWatchdog": ("blendjax.btt.watchdog", "FleetWatchdog"),
     "get_primary_ip": ("blendjax.btt.utils", "get_primary_ip"),
 }
@@ -44,6 +46,7 @@ _LAZY_MODULES = (
     "prefetch",
     "env",
     "envpool",
+    "vector_env",
     "env_rendering",
     "watchdog",
     "torch_compat",

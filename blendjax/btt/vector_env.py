@@ -1,0 +1,135 @@
+"""gymnasium ``VectorEnv`` adapter over :class:`blendjax.btt.envpool.EnvPool`.
+
+The reference exposes single environments through the classic gym API
+(``pkg_pytorch/blendtorch/btt/env.py:195-313``); its fleet story stops at
+N independent envs.  blendjax's ``EnvPool`` already steps a whole Blender
+fleet in pipelined lockstep; this module makes that fleet a drop-in
+``gymnasium.vector.VectorEnv`` so vectorized agent libraries (CleanRL-
+style PPO loops, SB3 VecEnv consumers via shims, ...) can drive Blender
+fleets unchanged.
+
+Autoreset follows gymnasium's NEXT_STEP mode, which is exactly
+``EnvPool``'s native behavior: a terminated instance returns its terminal
+observation with ``terminations[i] = True``; the reset happens on the
+*next* ``step`` call, which returns the fresh observation with zero
+reward.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import gymnasium as _gym
+    from gymnasium.vector.utils import batch_space as _batch_space
+except ImportError:  # pragma: no cover - gymnasium is an optional dep
+    _gym = None
+
+
+def _require_gymnasium():
+    if _gym is None:
+        raise ImportError(
+            "gymnasium is required for BlenderVectorEnv; pip install gymnasium"
+        )
+
+
+if _gym is not None:
+
+    class BlenderVectorEnv(_gym.vector.VectorEnv):
+        """A fleet of remote Blender environments as one vector env.
+
+        Params
+        ------
+        pool: EnvPool
+            Connected pool (see :func:`blendjax.btt.envpool.launch_env_pool`).
+            The adapter owns it: ``close()`` closes the pool.
+        single_observation_space / single_action_space: gymnasium.Space
+            Per-instance spaces (the wire protocol is schema-free, so the
+            caller declares them, exactly like the reference's
+            ``OpenAIRemoteEnv`` subclasses do).
+        """
+
+        metadata = {"autoreset_mode": (
+            _gym.vector.AutoresetMode.NEXT_STEP
+            if hasattr(_gym.vector, "AutoresetMode") else "next_step"
+        )}
+
+        def __init__(self, pool, single_observation_space,
+                     single_action_space):
+            self._pool = pool
+            self.num_envs = pool.num_envs
+            self.single_observation_space = single_observation_space
+            self.single_action_space = single_action_space
+            self.observation_space = _batch_space(
+                single_observation_space, pool.num_envs
+            )
+            self.action_space = _batch_space(
+                single_action_space, pool.num_envs
+            )
+
+        @staticmethod
+        def _as_batched(obs):
+            # collate() returns a dict/tuple pytree for structured
+            # observations (Dict/Tuple spaces): leave those alone —
+            # np.asarray would collapse them to a 0-d object array
+            if isinstance(obs, (dict, tuple, list)):
+                return obs
+            return np.asarray(obs)
+
+        def reset(self, *, seed=None, options=None):
+            # remote scenes seed at launch (-btseed); a per-reset seed has
+            # no remote hook, mirroring the reference's OpenAIRemoteEnv
+            obs, infos = self._pool.reset()
+            return self._as_batched(obs), {"env_infos": infos}
+
+        def step(self, actions):
+            obs, rewards, dones, infos = self._pool.step(list(actions))
+            terminations = np.asarray(dones, dtype=bool)
+            truncations = np.zeros(self.num_envs, dtype=bool)
+            return (
+                self._as_batched(obs),
+                rewards,
+                terminations,
+                truncations,
+                {"env_infos": infos},
+            )
+
+        def close_extras(self, **kwargs):
+            self._pool.close()
+
+else:  # pragma: no cover
+
+    class BlenderVectorEnv:  # noqa: D401 - stub keeps imports harmless
+        """Unavailable: gymnasium is not installed."""
+
+        def __init__(self, *a, **k):
+            _require_gymnasium()
+
+
+def launch_vector_env(scene, script, num_instances, single_observation_space,
+                      single_action_space, **kwargs):
+    """Launch a Blender fleet and wrap it as a gymnasium ``VectorEnv``.
+
+    Context manager; extra kwargs flow to
+    :func:`blendjax.btt.envpool.launch_env_pool` (and on to each
+    instance's CLI).
+    """
+    _require_gymnasium()
+    from contextlib import contextmanager
+
+    from blendjax.btt.envpool import launch_env_pool
+
+    @contextmanager
+    def _cm():
+        with launch_env_pool(
+            scene=scene, script=script, num_instances=num_instances, **kwargs
+        ) as pool:
+            env = BlenderVectorEnv(
+                pool, single_observation_space, single_action_space
+            )
+            try:
+                yield env
+            finally:
+                env.close()
+
+    return _cm()

@@ -78,17 +78,21 @@ void writer() {
       if (rc != 0) break;
     }
     // the reader aliases THIS mapping: close (munmap) only after it has
-    // drained the generation
-    while (g_ack_gen.load(std::memory_order_acquire) < gen) {
+    // drained the generation (or gave up — fail breaks the wait so a
+    // reader abort can't deadlock the binary)
+    while (g_ack_gen.load(std::memory_order_acquire) < gen &&
+           !fail.load()) {
       usleep(100);
     }
     bjr_close(h, /*unlink_shm=*/1);
+    if (fail.load()) return;
   }
 }
 
 void reader() {
   for (int gen = 0; gen < kGenerations; ++gen) {
     while (g_pub_gen.load(std::memory_order_acquire) < gen) {
+      if (fail.load()) return;  // writer aborted: nothing will be published
       usleep(100);
     }
     void* alias =

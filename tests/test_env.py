@@ -256,3 +256,51 @@ def test_gymnasium_adapter_api(fake_blender):
         assert isinstance(info, dict)
     finally:
         env.close()
+
+
+def test_vector_env_gymnasium_contract(fake_blender):
+    """BlenderVectorEnv follows the gymnasium VectorEnv API over a real
+    (fake-Blender) fleet: batched spaces, 5-tuple step, NEXT_STEP
+    autoreset semantics matching EnvPool's native behavior."""
+    import gymnasium
+
+    from blendjax.btt.vector_env import launch_vector_env
+
+    obs_space = gymnasium.spaces.Box(-np.inf, np.inf, shape=(), dtype=np.float64)
+    act_space = gymnasium.spaces.Box(-10.0, 10.0, shape=(), dtype=np.float64)
+    with launch_vector_env(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        single_observation_space=obs_space,
+        single_action_space=act_space,
+        background=True,
+        horizon=4,
+        timeoutms=30000,
+    ) as env:
+        assert env.num_envs == 2
+        assert env.observation_space.shape == (2,)
+        assert env.action_space.shape == (2,)
+
+        obs, info = env.reset()
+        assert obs.shape == (2,)
+        np.testing.assert_allclose(obs, [0.0, 0.0])
+        assert "env_infos" in info
+
+        obs, rew, term, trunc, info = env.step(np.array([1.0, 3.0]))
+        np.testing.assert_allclose(obs, [1.0, 3.0])
+        np.testing.assert_allclose(rew, [0.1, 0.3])
+        assert term.dtype == bool and trunc.dtype == bool
+        assert not term.any() and not trunc.any()
+
+        # run to termination; NEXT_STEP autoreset: the step AFTER
+        # termination returns the reset observation with zero reward
+        for _ in range(6):
+            obs, rew, term, trunc, info = env.step(np.array([2.0, 2.0]))
+            if term.any():
+                break
+        assert term.all()
+        obs, rew, term, trunc, info = env.step(np.array([7.0, 7.0]))
+        np.testing.assert_allclose(obs, [0.0, 0.0])
+        np.testing.assert_allclose(rew, [0.0, 0.0])
+        assert not term.any()
