@@ -298,6 +298,9 @@ def main(argv=None):
                       "child (device child left running)"})
         cpu_env = dict(dev_env)
         cpu_env["JAX_PLATFORMS"] = "cpu"
+        # the fault-injection hook models the ACCELERATOR backend hanging;
+        # the cpu fallback never touches that backend
+        cpu_env.pop("BJX_FAKE_SLOW_INIT_S", None)
         cpu = DeviceChild(
             device_cmd([
                 "--budget", str(max(30.0, budget.remaining() - slack)),

@@ -518,6 +518,12 @@ def main(argv=None):
     emit({"phase": "device_init_start",
           "jax_platforms_env": os.environ.get("JAX_PLATFORMS", "")})
 
+    # fault injection for the orchestrator's watchdog test: pretend the
+    # backend hangs this long before init (how round 2's bench died)
+    fake_hang = float(os.environ.get("BJX_FAKE_SLOW_INIT_S", "0") or 0)
+    if fake_hang > 0:
+        time.sleep(fake_hang)
+
     # honor $JAX_PLATFORMS even when sitecustomize pre-registers a backend
     plat = os.environ.get("JAX_PLATFORMS")
     t0 = time.monotonic()

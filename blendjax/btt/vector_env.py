@@ -56,6 +56,11 @@ if _gym is not None:
 
         def __init__(self, pool, single_observation_space,
                      single_action_space):
+            if not getattr(pool, "autoreset", False):
+                raise ValueError(
+                    "BlenderVectorEnv advertises NEXT_STEP autoreset and "
+                    "requires an EnvPool built with autoreset=True"
+                )
             self._pool = pool
             self.num_envs = pool.num_envs
             self.single_observation_space = single_observation_space

@@ -77,11 +77,11 @@ void writer() {
       check(rc == 0, "bjr_write");
       if (rc != 0) break;
     }
-    // the reader aliases THIS mapping: close (munmap) only after it has
-    // drained the generation (or gave up — fail breaks the wait so a
-    // reader abort can't deadlock the binary)
-    while (g_ack_gen.load(std::memory_order_acquire) < gen &&
-           !fail.load()) {
+    // the reader aliases THIS mapping: close (munmap) strictly after the
+    // reader acked the generation — it acks on failure paths too, so
+    // waiting on the ack alone can neither deadlock nor munmap pages the
+    // reader is still dereferencing
+    while (g_ack_gen.load(std::memory_order_acquire) < gen) {
       usleep(100);
     }
     bjr_close(h, /*unlink_shm=*/1);
