@@ -31,7 +31,11 @@ if os.path.dirname(HERE) not in sys.path:
 REFERENCE_HZ = 2000.0  # Readme.md:95, physics-only stepping
 
 
-def run(args):
+def launch_pool_for(args):
+    """One copy of the fleet setup for both configurations: fake-Blender
+    fallback, env fixture script, and a randomized port base so
+    back-to-back benchmark children can't collide on the launcher's
+    default 11000 while lingering sockets drain."""
     from blendjax.btt.envpool import launch_env_pool
 
     os.environ.setdefault(
@@ -40,13 +44,10 @@ def run(args):
             os.path.dirname(HERE), "tests", "helpers", "fake_blender.py"
         ),
     )
-    script = os.path.join(os.path.dirname(HERE), "tests", "blender", "env.blend.py")
-
-    # randomized port base: back-to-back benchmark children (e.g. the
-    # no-physics and with-physics configurations) must not collide on the
-    # launcher's default 11000 while lingering sockets drain
-    start_port = 20000 + (os.getpid() * 37) % 20000
-    with launch_env_pool(
+    script = os.path.join(
+        os.path.dirname(HERE), "tests", "blender", "env.blend.py"
+    )
+    return launch_env_pool(
         scene="",
         script=script,
         num_instances=args.instances,
@@ -54,8 +55,12 @@ def run(args):
         timeoutms=30000,
         horizon=1_000_000_000,  # episodes never end inside the window
         physics_us=args.physics_us,
-        start_port=start_port,
-    ) as pool:
+        start_port=20000 + (os.getpid() * 37) % 20000,
+    )
+
+
+def run(args):
+    with launch_pool_for(args) as pool:
         pool.reset()
         actions = [0.5] * args.instances
         # warmup: first exchanges absorb connect + frame-loop spin-up
@@ -83,6 +88,36 @@ def run(args):
     }
 
 
+def run_podracer(args):
+    """Overlapped actor/learner configuration (Sebulba, arXiv:2104.06272):
+    env stepping + policy inference in an actor thread concurrent with
+    jitted REINFORCE updates — RL throughput WITH learning, not just the
+    RPC stack."""
+    import numpy as np
+
+    from blendjax.models.actor_learner import ActorLearner
+
+    values = np.array([0.0, 1.0], np.float64)
+    with launch_pool_for(args) as pool:
+        al = ActorLearner(
+            pool, obs_dim=1, num_actions=2, rollout_len=32, seed=0,
+            action_map=lambda a: list(values[np.asarray(a)]),
+        )
+        al.run(num_updates=2)  # warmup: absorbs jit compiles
+        stats = al.run(seconds=args.seconds)  # the measured window
+    return {
+        "metric": "rl_env_steps_per_sec_with_learning",
+        "value": stats["env_steps_per_sec"],
+        "unit": "env-steps/sec",
+        "instances": args.instances,
+        "updates_per_sec": stats["updates_per_sec"],
+        "vs_baseline": round(stats["env_steps_per_sec"] / REFERENCE_HZ, 3),
+        "includes_physics": args.physics_us > 0,
+        "includes_learning": True,
+        "architecture": "sebulba (overlapped actor/learner)",
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--instances", type=int, default=4)
@@ -91,8 +126,21 @@ def main(argv=None):
         "--physics-us", type=int, default=0,
         help="busy-wait per env step, simulating physics solver cost",
     )
+    ap.add_argument("--podracer", action="store_true",
+                    help="overlapped actor/learner configuration")
     args = ap.parse_args(argv)
-    print(json.dumps(run(args)))
+    if args.podracer:
+        # jax runs in this child: keep it off a possibly-slow accelerator
+        # tunnel — the policy is tiny and the subject is the RL stack
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        print(json.dumps(run_podracer(args)))
+    else:
+        print(json.dumps(run(args)))
 
 
 if __name__ == "__main__":

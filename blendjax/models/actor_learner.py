@@ -1,0 +1,231 @@
+"""Sebulba-style decoupled actor/learner for remote Blender fleets.
+
+Podracer architectures (arXiv:2104.06272) split RL into an *actor* that
+steps environments and a *learner* that updates parameters, running
+concurrently with a trajectory queue between them.  That split fits
+blendjax exactly: Blender env steps are host-bound RPCs
+(``EnvPool.step`` — REQ/REP into the fleet's animation loops), while the
+policy update is device-bound XLA — interleaving them serially (the
+reference's only mode, and ``train_reinforce.py``'s) idles each side
+half the time.  Here the actor thread keeps the fleet stepping at full
+RPC rate with jitted policy inference on parameter snapshots while the
+learner consumes fixed-length trajectory segments and publishes fresh
+params; staleness is bounded by the queue depth (actor policy lags the
+learner by at most ``queue_size`` updates — standard Sebulba trade).
+
+No reference counterpart (its RL story is one blocking env,
+``pkg_pytorch/blendtorch/btt/env.py``); net-new capability like the
+SeqFormer stack.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from blendjax.models import policy
+from blendjax.models.train import TrainState, make_train_step
+
+
+class ActorLearner:
+    """Overlapped actor/learner REINFORCE over an :class:`EnvPool`.
+
+    Params
+    ------
+    pool: EnvPool
+        Connected fleet (autoreset recommended); the caller owns it.
+    obs_dim, num_actions: int
+        Policy sizes (``continuous=True`` for a Gaussian head).
+    rollout_len: int
+        Steps per trajectory segment (the queue's unit of work).
+    queue_size: int
+        Segments buffered between actor and learner — also the bound on
+        actor policy staleness, in updates.
+    action_map: callable | None
+        Maps the sampled action array (shape (N,)) to the list the
+        producers expect (e.g. discrete index -> motor force).
+    """
+
+    def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
+                 queue_size=4, optimizer=None, gamma=0.99, seed=0,
+                 continuous=False, action_map=None):
+        self.pool = pool
+        self.rollout_len = rollout_len
+        self.gamma = gamma
+        self.continuous = continuous
+        self.action_map = action_map or (lambda a: list(np.asarray(a)))
+        params = policy.init(
+            jax.random.PRNGKey(seed), obs_dim, num_actions,
+            continuous=continuous,
+        )
+        self.opt = optimizer or optax.adam(3e-3)
+        self.state = TrainState.create(params, self.opt)
+        self._seed = seed
+        #: snapshot the actor samples from; swapped atomically (CPython
+        #: attribute assignment) by the learner after each update
+        self._actor_params = params
+
+        def _sample_step(params, key, obs):
+            # one jitted dispatch per env step: key advance + sampling
+            # fused (a separate jax.random.split call would double the
+            # per-step dispatch overhead, which dominates on small hosts)
+            key, sub = jax.random.split(key)
+            action, logp = policy.sample_action(params, sub, obs)
+            return action, logp, key
+
+        self._sample = jax.jit(_sample_step)
+
+        def loss_fn(p, batch):
+            returns = policy.discounted_returns(
+                batch["rewards"], batch["dones"], gamma
+            )
+            t, n = batch["rewards"].shape
+            return policy.reinforce_loss(
+                p,
+                batch["obs"].reshape(t * n, -1),
+                batch["actions"].reshape(t * n, *batch["actions"].shape[2:]),
+                returns.reshape(t * n),
+                continuous=continuous,
+            )
+
+        # donate=False ON PURPOSE: the actor thread samples from a params
+        # snapshot that must survive the next update; donating the state
+        # would invalidate the snapshot's buffers under the actor's feet
+        self._step = make_train_step(loss_fn, self.opt, donate=False)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._thread = None
+        self._actor_error = None
+        self._env_steps = 0
+
+    # -- actor side --------------------------------------------------------
+
+    def _actor(self):
+        try:
+            # derived from the constructor seed: runs are reproducible
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed), 0xAC708
+            )
+            obs, _ = self.pool.reset()
+            obs = np.asarray(obs, np.float32)
+            if obs.ndim == 1:
+                obs = obs[:, None]
+            while not self._stop.is_set():
+                seg_obs, seg_act, seg_rew, seg_done = [], [], [], []
+                params = self._actor_params  # snapshot for whole segment
+                for _ in range(self.rollout_len):
+                    action, _logp, rng = self._sample(params, rng, obs)
+                    action = np.asarray(action)
+                    nobs, rew, done, _ = self.pool.step(
+                        self.action_map(action)
+                    )
+                    seg_obs.append(obs)
+                    seg_act.append(action)
+                    seg_rew.append(np.asarray(rew, np.float32))
+                    seg_done.append(np.asarray(done, bool))
+                    obs = np.asarray(nobs, np.float32)
+                    if obs.ndim == 1:
+                        obs = obs[:, None]
+                    self._env_steps += self.pool.num_envs
+                seg = (
+                    np.stack(seg_obs),
+                    np.stack(seg_act),
+                    np.stack(seg_rew),
+                    np.stack(seg_done),
+                )
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(seg, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as exc:  # noqa: BLE001 - surfaced by learner
+            self._actor_error = exc
+            self._stop.set()
+
+    # -- learner side ------------------------------------------------------
+
+    def run(self, num_updates=None, seconds=None):
+        """Run the overlapped loop for ``num_updates`` learner steps OR a
+        ``seconds`` wall-clock budget (whichever is given; both = either
+        limit ends the run); returns a stats dict.
+
+        Re-runnable: each call gets a fresh stop event, a zeroed step
+        counter, and an emptied queue (a previous run's buffered segments
+        carry a stale policy and would also corrupt the throughput math).
+        """
+        if num_updates is None and seconds is None:
+            raise ValueError("pass num_updates and/or seconds")
+        if self._thread is not None and self._thread.is_alive():
+            # a leaked actor (previous run's join timed out on a stalled
+            # RPC) sharing the REQ sockets with a fresh one would corrupt
+            # the zmq protocol and double-count env steps
+            raise RuntimeError(
+                "previous run's actor thread is still alive; close the "
+                "pool or wait before re-running"
+            )
+        self._stop = threading.Event()
+        self._actor_error = None
+        self._env_steps = 0
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread = threading.Thread(
+            target=self._actor, daemon=True, name="bjx-actor"
+        )
+        t0 = time.perf_counter()
+        deadline = t0 + seconds if seconds is not None else None
+        self._thread.start()
+        losses, seg_rewards = [], []
+        try:
+            while True:
+                if num_updates is not None and len(losses) >= num_updates:
+                    break
+                if deadline is not None and time.perf_counter() >= deadline:
+                    break
+                while True:
+                    if self._actor_error is not None:
+                        raise RuntimeError(
+                            "actor thread failed"
+                        ) from self._actor_error
+                    try:
+                        seg = self._q.get(timeout=0.5)
+                        break
+                    except queue.Empty:
+                        if (deadline is not None
+                                and time.perf_counter() >= deadline):
+                            seg = None
+                            break
+                if seg is None:
+                    break
+                batch = jax.device_put(
+                    {"obs": seg[0], "actions": seg[1],
+                     "rewards": seg[2], "dones": seg[3]}
+                )
+                self.state, loss = self._step(self.state, batch)
+                self._actor_params = self.state.params
+                losses.append(float(loss))
+                seg_rewards.append(float(seg[2].mean()))
+        finally:
+            self._stop.set()
+            self._thread.join(timeout=10)
+        elapsed = time.perf_counter() - t0
+        return {
+            "updates": len(losses),
+            "env_steps": self._env_steps,
+            "env_steps_per_sec": round(self._env_steps / elapsed, 1),
+            "updates_per_sec": round(len(losses) / elapsed, 2),
+            "first_segment_reward": seg_rewards[0] if seg_rewards else None,
+            "last_segment_reward": seg_rewards[-1] if seg_rewards else None,
+            "segment_rewards": seg_rewards,
+            "losses": losses,
+            "elapsed_s": round(elapsed, 3),
+        }
