@@ -232,7 +232,8 @@ def main():
     if moe:
         extras["moe_compare"] = {
             k: moe[k]
-            for k in ("dense", "topk", "topk_over_dense", "experts", "top_k")
+            for k in ("mlp", "dense", "topk", "topk_over_dense_mixture",
+                      "experts", "top_k")
             if k in moe
         }
     if host:

@@ -5,11 +5,12 @@ on every token and the gate weights the sum (``seqformer._moe_apply``) —
 expert **sharding**, but compute scales with ``n_experts`` regardless of
 sparsity (VERDICT r01 weak #7).  This module adds true routed expert
 parallelism the TPU way: top-k gating with a fixed per-expert **capacity**
-so every shape is static under ``jit``, GShard-style one-hot dispatch/
-combine einsums (they compile to gather/scatter on the MXU and to
-all-to-all collectives when the expert stacks shard over an ``'expert'``
-mesh axis), and dropped-token handling (tokens beyond capacity contribute
-nothing; the transformer's residual connection carries them through).
+so every shape is static under ``jit``, scatter/gather dispatch into a
+per-expert slot arena (O(k*n*d) data movement; XLA lowers the arena
+scatter to dynamic-update-slices, and sharding the expert axis turns the
+slot traffic into all-to-all collectives), and dropped-token handling
+(tokens beyond capacity contribute nothing; the transformer's residual
+connection carries them through).
 
 Compute per token is ``k`` experts instead of ``n_experts``; at
 ``k == n_experts`` with ample capacity the output equals the dense
@@ -36,8 +37,9 @@ def expert_capacity(n_tokens, n_experts, k, capacity_factor):
     return max(1, math.ceil(k * n_tokens / n_experts * capacity_factor))
 
 
-def route_topk(probs, k, capacity):
-    """Top-k routing with capacity-bounded slot assignment.
+def topk_assignments(probs, k, capacity):
+    """Top-k routing with capacity-bounded slot assignment — THE routing
+    policy, shared by the apply path and the one-hot matrix view.
 
     Params
     ------
@@ -45,25 +47,34 @@ def route_topk(probs, k, capacity):
     k: experts per token.
     capacity: slots per expert (static).
 
-    Returns ``(dispatch, combine, keep)``:
-
-    - ``dispatch``: (k*n, e, capacity) one-hot — assignment rows are
-      **choice-major** (all first choices before any second choice, so
-      first choices claim capacity slots first).
-    - ``combine``: dispatch scaled by the renormalized top-k gate weight.
-    - ``keep``: (k*n,) bool — assignments that won a slot.
+    Returns ``(idx, pos, keep, gate_w)``, all choice-major over ``k*n``
+    assignment rows (row ``j*n + i`` is token i's j-th choice, so first
+    choices claim capacity slots first): chosen expert per row, slot
+    index within that expert, whether the row won a slot, and the
+    renormalized top-k gate weights (n, k).
     """
     n, e = probs.shape
     gate_w, gate_idx = jax.lax.top_k(probs, k)  # (n, k)
     gate_w = gate_w / jnp.clip(gate_w.sum(-1, keepdims=True), 1e-9, None)
-
-    # choice-major flattening: row j*n + i is token i's j-th choice
     idx = gate_idx.T.reshape(k * n)
     oh_i = jax.nn.one_hot(idx, e, dtype=jnp.int32)
     pos = jnp.cumsum(oh_i, axis=0) - oh_i  # prior assignments per expert
     pos = (pos * oh_i).sum(-1)  # (k*n,) slot index within the expert
     keep = pos < capacity
+    return idx, pos, keep, gate_w
 
+
+def route_topk(probs, k, capacity):
+    """One-hot matrix view of :func:`topk_assignments` (kept for tests
+    and for expressing the dispatch as explicit (k*n, e, capacity)
+    tensors; the apply path uses the scatter/gather form directly).
+
+    Returns ``(dispatch, combine, keep)``: one-hot dispatch, dispatch
+    scaled by the renormalized gate weight, and the slot-won mask.
+    """
+    n, e = probs.shape
+    idx, pos, keep, gate_w = topk_assignments(probs, k, capacity)
+    capacity = int(capacity)
     oh = jax.nn.one_hot(idx, e, dtype=probs.dtype) * keep[:, None]
     slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)
     dispatch = oh[:, :, None] * slot[:, None, :]  # (k*n, e, capacity)
@@ -89,6 +100,18 @@ def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25):
     routing is an apply-time choice, so checkpoints swap freely between
     dense and routed evaluation.
 
+    Dispatch/combine are a SCATTER into the (e*capacity) slot arena and a
+    GATHER back — O(k*n*d) data movement.  The earlier GShard-style
+    one-hot einsum dispatch cost ~1.25*k^2*n^2*d MACs — roughly the
+    expert MLP's own FLOPs again per einsum at bench shapes, and
+    QUADRATIC in tokens where the MLP is linear, so it only got worse
+    with batch/sequence length; that overhead is why routed eval
+    measured slower than it should (VERDICT r2 weak #7).  Slot indices
+    are unique by construction (cumsum positions), so the scatter-add
+    has no collisions; dropped assignments target a sentinel row that is
+    sliced off before the expert MLP and reads back zeros in the
+    gather.
+
     Returns ``(y, aux)`` with ``y`` (b, t, d) and ``aux`` a dict carrying
     ``aux_loss`` (load balance) and ``dispatch_fraction`` (1 - dropped).
     """
@@ -100,20 +123,25 @@ def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25):
 
     probs = jax.nn.softmax(dense_apply(p["gate"], xf, dtype=jnp.float32), -1)
     capacity = expert_capacity(n, e, k, capacity_factor)
-    dispatch, combine, keep = route_topk(probs, k, capacity)
 
-    x_rep = jnp.tile(xf, (k, 1))  # choice-major, aligned with dispatch rows
-    expert_in = jnp.einsum(
-        "nec,nd->ecd", dispatch.astype(dtype), x_rep.astype(dtype)
-    )
+    idx, pos, keep, gate_w = topk_assignments(probs, k, capacity)
+    slot = jnp.where(keep, idx * capacity + pos, e * capacity)  # sentinel
+
+    x_rep = jnp.tile(xf, (k, 1)).astype(dtype)
+    arena = jnp.zeros((e * capacity + 1, d), dtype).at[slot].add(x_rep)
+    expert_in = arena[:-1].reshape(e, capacity, d)
     h = gelu(
         jnp.einsum("ecd,edf->ecf", expert_in, p["w1"].astype(dtype))
         + p["b1"][:, None, :].astype(dtype)
     )
     out = jnp.einsum("ecf,efd->ecd", h, p["w2"].astype(dtype))
     out = out + p["b2"][:, None, :].astype(dtype)
-    y = jnp.einsum("nec,ecd->nd", combine.astype(dtype), out)
-    y = y.reshape(k, n, d).sum(0).reshape(b, t, d)
+    out_flat = jnp.concatenate(
+        [out.reshape(e * capacity, d), jnp.zeros((1, d), dtype)]
+    )
+    scale = (gate_w.T.reshape(k * n) * keep).astype(dtype)
+    y = (out_flat[slot] * scale[:, None]).reshape(k, n, d).sum(0)
+    y = y.reshape(b, t, d)
 
     aux = {
         "aux_loss": load_balance_loss(probs, jnp.argmax(probs, -1)),
