@@ -53,10 +53,12 @@ def host_only_fallback(seconds=10.0):
     from blendjax.btt.dataset import RemoteIterableDataset
     from blendjax.btt.loader import BatchLoader
 
-    addrs, procs = launch_producers(4, raw=True, width=640, height=480)
+    cores = os.cpu_count() or 1
+    n_prod = 4 if cores >= 4 else 1
+    addrs, procs = launch_producers(n_prod, raw=True, width=640, height=480)
     try:
         ds = RemoteIterableDataset(addrs, max_items=10**9, timeoutms=60000)
-        with BatchLoader(ds, batch_size=8, num_workers=4) as loader:
+        with BatchLoader(ds, batch_size=8, num_workers=min(4, cores)) as loader:
             it = iter(loader)
             for _ in range(8):
                 next(it)  # warmup

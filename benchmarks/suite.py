@@ -98,6 +98,7 @@ def phase_host_stream(args, budget, launch):
             dt = time.perf_counter() - t0
         emit({
             "phase": "host_stream",
+            "overlapped_device_init": bool(args._overlap),
             "batches": n,
             "elapsed_s": round(dt, 3),
             "items_per_sec": round(n * args.batch / dt, 2),
@@ -117,6 +118,7 @@ class DeviceChild:
         self.init_seen = threading.Event()
         self.proc = subprocess.Popen(
             cmd,
+            stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=None,  # inherit: child diagnostics reach parent logs
             text=True,
@@ -125,6 +127,14 @@ class DeviceChild:
         )
         self._t = threading.Thread(target=self._reader, daemon=True)
         self._t.start()
+
+    def go(self):
+        """Release a --wait-go child into its measured phases."""
+        try:
+            self.proc.stdin.write("go\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, ValueError, OSError):
+            pass  # child already exited; nothing to release
 
     def _reader(self):
         for line in self.proc.stdout:
@@ -240,12 +250,6 @@ def main(argv=None):
     env["JAX_PLATFORMS"] = "cpu"  # producers never touch the accelerator
     launch = make_launcher(args, env)
 
-    if not args.skip_host and budget.has(25, "host_stream"):
-        try:
-            phase_host_stream(args, budget, launch)
-        except Exception as e:  # noqa: BLE001 - device phases may still fit
-            note(f"host_stream failed: {type(e).__name__}: {e}")
-
     def device_cmd(extra):
         cmd = [
             sys.executable, os.path.join(HERE, "suite_device.py"),
@@ -279,15 +283,39 @@ def main(argv=None):
         return cmd + extra
 
     dev_env = dict(child_env())
-    # the accelerator child inherits the caller's JAX_PLATFORMS (if any)
+    # the accelerator child inherits the caller's JAX_PLATFORMS (if any).
+    # On an accelerator backend, spawn it BEFORE the host phase: init (the
+    # dominant cost on a tunneled TPU) is network-bound and overlaps the
+    # host-side measurement for free; --wait-go holds the child's MEASURED
+    # phases until the host window closes.  On a CPU backend init itself
+    # is CPU-heavy and would contend with the host window, so there the
+    # child is spawned after it.
     slack = 10.0
-    dev = DeviceChild(
-        device_cmd(["--budget", str(max(30.0, budget.remaining() - slack)),
-                    "--config", args.config,
-                    "--ring-nonce", args.ring_nonce]),
-        dev_env, "device",
-    )
-    children.append(dev)
+    overlap = (dev_env.get("JAX_PLATFORMS") or "").strip().lower() != "cpu"
+
+    def spawn_device():
+        extra = ["--budget", str(max(30.0, budget.remaining() - slack)),
+                 "--config", args.config,
+                 "--ring-nonce", args.ring_nonce]
+        if overlap:
+            extra.append("--wait-go")
+        d = DeviceChild(device_cmd(extra), dev_env, "device")
+        children.append(d)
+        return d
+
+    args._overlap = overlap
+    dev = spawn_device() if overlap else None
+
+    if not args.skip_host and budget.has(25, "host_stream"):
+        try:
+            phase_host_stream(args, budget, launch)
+        except Exception as e:  # noqa: BLE001 - device phases may still fit
+            note(f"host_stream failed: {type(e).__name__}: {e}")
+
+    if dev is None:
+        dev = spawn_device()
+    else:
+        dev.go()  # host measurement done: release the measured phases
 
     grace = args.device_init_grace
     if grace is None:

@@ -508,6 +508,11 @@ def main(argv=None):
     ap.add_argument("--ring-nonce", default=str(os.getpid()),
                     help="embedded in shm ring names; the parent passes its "
                          "own pid so its leak sweep finds our rings")
+    ap.add_argument("--wait-go", action="store_true",
+                    help="after device_init, block until a line arrives on "
+                         "stdin (or EOF).  The parent overlaps this child's "
+                         "backend init with its host-side phase, then sends "
+                         "'go' so the measured phases never contend with it")
     ap.add_argument("--gil-switch-us", type=int, default=500,
                     help="sys.setswitchinterval for this process, in "
                          "microseconds (0 keeps the 5 ms default). On a "
@@ -549,6 +554,8 @@ def main(argv=None):
     emit({"phase": "device_init", "seconds": round(init_s, 1),
           "device_kind": dev.device_kind, "platform": dev.platform,
           "config": args.config})
+    if args.wait_go:
+        sys.stdin.readline()  # parent's go (EOF if the parent died: proceed)
     tag = {"platform": dev.platform, "config": args.config,
            "width": args.width, "height": args.height}
 
