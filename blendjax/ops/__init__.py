@@ -1,6 +1,7 @@
 """Device-side input-pipeline ops (decode, color, augmentation)."""
 
 from blendjax.ops import augment, image
+from blendjax.ops.flash_attention import flash_attention, make_flash_attention
 from blendjax.ops.image import (
     decode_frames,
     decode_frames_pallas,
@@ -12,6 +13,8 @@ from blendjax.ops.image import (
 __all__ = [
     "augment",
     "image",
+    "flash_attention",
+    "make_flash_attention",
     "decode_frames",
     "decode_frames_pallas",
     "linear_to_srgb",

@@ -1,0 +1,189 @@
+"""Pallas TPU flash attention: block-wise online-softmax attention that
+never materializes the (T, T) score matrix.
+
+The SeqFormer's single-device attention (`full_attention`,
+``blendjax/parallel/ring_attention.py``) builds (B, H, T, T) scores —
+O(T^2) HBM traffic and memory, the classic long-context wall.  This
+kernel streams K/V blocks through VMEM, keeping the running max/sum and
+the output accumulator on-chip (the FlashAttention recurrence), so HBM
+traffic is O(T*D) and the MXU sees back-to-back (block_q, D) x
+(D, block_kv) and (block_q, block_kv) x (block_kv, D) matmuls.
+
+Grid layout: ``(B*H, T/block_q, T/block_kv)`` with the KV dimension
+innermost — TPU grid steps run sequentially per core, so the f32
+accumulator/max/sum scratch carries across KV steps and is written to
+the output on the last one.
+
+Differentiation: the forward is the fused kernel; the backward currently
+recomputes attention through the reference einsum path (``custom_vjp``)
+— gradients are exact, the O(T^2) memory returns only inside the
+backward, and ``jax.checkpoint`` around the call keeps training memory
+flat.  A fused backward kernel is the natural next step.
+
+Interpret mode (``interpret=True``) runs the same kernel on CPU for CI;
+parity against ``full_attention`` is tested both causal and not.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent in CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale, causal, block_q, block_kv, num_kv):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    k = k_ref[0].astype(jnp.float32)  # (block_kv, d)
+    v = v_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (block_q, block_kv)
+
+    if causal:
+        i = pl.program_id(1)
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        cols = j * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        s = jnp.where(cols <= rows, s, _NEG)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_cur)
+    alpha = jnp.exp(m_prev - m_cur)
+    l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_kv - 1)
+    def _emit():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    b, t, h, d = q.shape
+    if t % block_q or t % block_kv:
+        raise ValueError(
+            f"sequence length {t} must divide block_q={block_q} and "
+            f"block_kv={block_kv} (pad upstream or pick smaller blocks)"
+        )
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    num_q = t // block_q
+    num_kv = t // block_kv
+
+    kernel = functools.partial(
+        _kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, num_kv=num_kv,
+    )
+    if _VMEM is not None:
+        scratch = [
+            _VMEM((block_q, d), jnp.float32),
+            _VMEM((block_q, 128), jnp.float32),
+            _VMEM((block_q, 128), jnp.float32),
+        ]
+    else:  # pragma: no cover - jaxlib without the TPU pallas extension
+        scratch = [
+            jax.ShapeDtypeStruct((block_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((block_q, 128), jnp.float32),
+        ]
+    kwargs = {"scratch_shapes": scratch}
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_kv=128, interpret=False):
+    """Fused block-wise attention; same contract as ``full_attention``:
+    q/k/v (B, T, H, D) -> (B, T, H, D).
+
+    ``T`` must divide by both block sizes (pick blocks accordingly or pad
+    upstream).  ``interpret=True`` runs on CPU (CI parity tests).
+    """
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
+
+
+def _ref(q, k, v, causal, scale):
+    from blendjax.parallel.ring_attention import full_attention
+
+    return full_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out = flash_attention(
+        q, k, v, causal, scale, block_q, block_kv, interpret
+    )
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
+    q, k, v = res
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    _, vjp = jax.vjp(lambda q, k, v: _ref(q, k, v, causal, scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def make_flash_attention(causal=True, block_q=128, block_kv=128,
+                         interpret=False):
+    """``attn_fn`` closure for :func:`blendjax.models.seqformer.apply` —
+    drop-in for the default ``full_attention`` when T divides the block
+    sizes."""
+
+    def attn(q, k, v):
+        return flash_attention(
+            q, k, v, causal, None, block_q, block_kv, interpret
+        )
+
+    return attn
