@@ -205,6 +205,19 @@ def _unflat(xf, b, h):
     return xf.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct that inherits ``like``'s varying-manual-axes type,
+    so the kernel composes inside shard_map (e.g. as Ulysses' inner
+    attention) under vma typing."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _check_blocks(t, block_q, block_kv):
     if t % block_q or t % block_kv:
         raise ValueError(
@@ -238,8 +251,8 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
             pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            _sds((b * h, t, d), q.dtype, qf),
+            _sds((b * h, t), jnp.float32, qf),
         ],
         scratch_shapes=_scratch([
             (block_q, d), (block_q, 128), (block_q, 128)
@@ -296,7 +309,7 @@ def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
                   row_spec_i],
         out_specs=q_spec_i,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), qf.dtype),
+        out_shape=_sds((b * h, t, d), qf.dtype, qf),
         scratch_shapes=_scratch([(block_q, d)]),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
@@ -316,8 +329,8 @@ def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
                   row_spec_inner, row_spec_inner],
         out_specs=[kv_spec_mid, kv_spec_mid],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), kf.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), vf.dtype),
+            _sds((b * h, t, d), kf.dtype, qf),
+            _sds((b * h, t, d), vf.dtype, qf),
         ],
         scratch_shapes=_scratch([(block_kv, d), (block_kv, d)]),
         interpret=interpret,

@@ -131,30 +131,41 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      inner_attn=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
 
     Call inside ``shard_map`` with local shards (B, S/n, H, D); requires
     ``H % n == 0`` (enforced by ``all_to_all``).  Reshards seq->heads,
     attends over the full sequence for the local head group, reshards back.
+
+    ``inner_attn(q, k, v, causal=..., scale=...)`` overrides the
+    full-sequence attention — the natural slot for the fused Pallas
+    kernel (:func:`blendjax.ops.flash_attention`), since after the
+    all-to-all each device holds the COMPLETE sequence for its head
+    group and pays the O(S^2) score matrix right here.
     """
+    inner = inner_attn or full_attention
     # (B, S/n, H, D) -> (B, S, H/n, D)
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = full_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = inner(qh, kh, vh, causal=causal, scale=scale)
     # back to (B, S/n, H, D)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 def make_ring_attention(
-    mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None, head_axis=None
+    mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None,
+    head_axis=None, inner_attn=None,
 ):
     """Wrap :func:`ring_attention` / :func:`ulysses_attention` for global
     arrays sharded ``P(batch_axis, seq_axis, head_axis, None)`` over
     ``mesh``.
 
     Returns ``attn(q, k, v) -> out`` usable directly under ``jax.jit``.
+    ``inner_attn`` (ulysses only) swaps the per-head-group full-sequence
+    attention, e.g. for the fused Pallas flash kernel.
     Composes with data parallelism (``batch_axis='data'``) and — ring only
     — with head-sharded tensor parallelism (``head_axis='model'``): each
     device then ring-rotates K/V for its head block, so sequence and
@@ -171,7 +182,8 @@ def make_ring_attention(
         if head_axis is not None:
             raise ValueError("ulysses uses the head dim for its all-to-all; "
                              "head_axis sharding is ring-only")
-        inner = functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal)
+        inner = functools.partial(ulysses_attention, axis_name=seq_axis,
+                                  causal=causal, inner_attn=inner_attn)
     else:
         raise ValueError(f"unknown impl {impl!r} (want 'ring' or 'ulysses')")
     mapped = shard_map(

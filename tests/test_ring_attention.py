@@ -70,3 +70,36 @@ def test_uneven_heads_rejected():
     bad = jnp.zeros((B, S, 6, D))  # 6 heads not divisible by 8
     with pytest.raises(Exception):
         jax.jit(attn)(bad, bad, bad)
+
+
+def test_ulysses_with_flash_inner_matches_full():
+    """The Pallas flash kernel slots into Ulysses' per-head-group
+    full-sequence attention (after the all-to-all every device holds the
+    complete sequence) and reproduces the default inner attention."""
+    import functools
+
+    from blendjax.ops.flash_attention import flash_attention
+    from blendjax.parallel import make_mesh
+    from blendjax.parallel.ring_attention import (
+        full_attention,
+        make_ring_attention,
+    )
+
+    mesh = make_mesh({"seq": 4})
+    B, T, H, D = 2, 128, 4, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(k1, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(k2, (B, T, H, D), jnp.float32)
+    v = jax.random.normal(k3, (B, T, H, D), jnp.float32)
+
+    flash_inner = functools.partial(
+        flash_attention, block_q=32, block_kv=32, interpret=True
+    )
+    attn = make_ring_attention(
+        mesh, impl="ulysses", causal=True, inner_attn=flash_inner
+    )
+    got = attn(q, k, v)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
