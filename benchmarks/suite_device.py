@@ -303,7 +303,21 @@ def phase_seqformer(args, budget, launch, tag):
         params = seqformer.init(jax.random.PRNGKey(0), **kwargs)
         opt = optax.adam(1e-4)
         state = TrainState.create(params, opt)
-        train_step = make_train_step(seqformer.loss_fn, opt)
+        loss_fn = seqformer.loss_fn
+        if args.attn == "flash" and T % 128 == 0:
+            import functools
+
+            from blendjax.ops.flash_attention import make_flash_attention
+
+            loss_fn = functools.partial(
+                seqformer.loss_fn,
+                # compiled kernel on TPU; interpreter elsewhere (CPU
+                # fallback child) so the flag degrades instead of failing
+                attn_fn=make_flash_attention(
+                    causal=True, interpret=tag["platform"] != "tpu"
+                ),
+            )
+        train_step = make_train_step(loss_fn, opt)
 
         rng = np.random.default_rng(0)
         warm = seqformer.make_episode_batch(
@@ -501,6 +515,9 @@ def main(argv=None):
     ap.add_argument("--d-model", type=int, default=1024)
     ap.add_argument("--n-heads", type=int, default=8)
     ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--attn", choices=["full", "flash"], default="full",
+                    help="seqformer attention: 'flash' uses the fused "
+                         "Pallas kernel (needs seq_len-1 divisible by 128)")
     ap.add_argument("--skip-seqformer", action="store_true")
     ap.add_argument("--skip-moe", action="store_true")
     ap.add_argument("--moe-experts", type=int, default=8)
