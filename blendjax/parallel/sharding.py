@@ -145,7 +145,9 @@ def make_seqformer_train_step(
 
     Composes every parallelism the framework supports in one jitted step:
     batch dp-sharded over ``data_axis``, sequence sharded over ``seq_axis``
-    (ring attention — or Ulysses with ``attn_impl='ulysses'``), attention
+    (ring attention — Ulysses with ``attn_impl='ulysses'``, or Ulysses
+    with the fused Pallas flash kernel as the per-head-group inner
+    attention with ``attn_impl='ulysses_flash'``), attention
     heads + MLP tensor-parallel over ``model_axis``, MoE experts over
     ``expert_axis`` (see :func:`seqformer_rules`).  ``moe_impl='topk'``
     switches the expert layer from the dense mixture to routed expert
@@ -160,6 +162,23 @@ def make_seqformer_train_step(
     from blendjax.models import seqformer
     from blendjax.parallel.ring_attention import make_ring_attention
 
+    inner_attn = None
+    if attn_impl == "ulysses_flash":
+        from blendjax.ops.flash_attention import flash_attention
+
+        attn_impl = "ulysses"
+        # compiled kernel on TPU; the interpreter elsewhere keeps the
+        # option runnable on the CPU mesh used in CI
+        interpret = jax.default_backend() != "tpu"
+
+        def inner_attn(q, k, v, causal=False, scale=None):
+            t = q.shape[1]
+            blk = next(
+                (b for b in (128, 64, 32) if t % b == 0), t
+            )  # largest tile dividing the gathered sequence
+            return flash_attention(
+                q, k, v, causal, scale, blk, blk, interpret
+            )
     attn = make_ring_attention(
         mesh,
         seq_axis=seq_axis,
@@ -167,6 +186,7 @@ def make_seqformer_train_step(
         impl=attn_impl,
         batch_axis=data_axis,
         head_axis=model_axis if attn_impl == "ring" else None,
+        inner_attn=inner_attn,
     )
     rules = seqformer_rules(model_axis, expert_axis)
     loss = functools.partial(
