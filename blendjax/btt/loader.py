@@ -46,6 +46,11 @@ class BatchLoader:
         Drop the final partial batch.
     prefetch_batches: int
         Bound on buffered items, expressed in batches.
+    gate: TransferGate | None
+        When set, workers pause at batch boundaries while a host->device
+        transfer holds the gate closed (see ``prefetch.TransferGate``) —
+        keeps feed threads off the core the transfer pump needs on
+        core-starved hosts.
     """
 
     def __init__(
@@ -58,6 +63,7 @@ class BatchLoader:
         drop_last=True,
         prefetch_batches=2,
         timer=None,
+        gate=None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -67,6 +73,7 @@ class BatchLoader:
         self.collate_fn = collate_fn or default_collate
         self.shard = shard
         self.drop_last = drop_last
+        self.gate = gate
         self.timer = timer or StageTimer()
         self._queue = queue.Queue(maxsize=max(2, prefetch_batches))
         self._stop = threading.Event()
@@ -121,7 +128,7 @@ class BatchLoader:
             if self.collate_fn is default_collate and hasattr(
                 self.dataset, "stream_batches"
             ):
-                for out in self.dataset.stream_batches(
+                batches = self.dataset.stream_batches(
                     self.batch_size,
                     worker_id=worker_id,
                     num_workers=self.num_workers,
@@ -130,7 +137,16 @@ class BatchLoader:
                     stop_event=self._stop,
                     drop_last=self.drop_last,
                     timer=self.timer,
-                ):
+                )
+                while True:
+                    if self.gate is not None:
+                        self.gate.wait()  # next() does this worker's heavy
+                        # lifting (ring drain + batch assembly): hold it at
+                        # the boundary while a transfer owns the core
+                    try:
+                        out = next(batches)
+                    except StopIteration:
+                        break
                     if not self._put(out):
                         return
                     if self._stop.is_set():
@@ -147,6 +163,8 @@ class BatchLoader:
             ):
                 batch.append(item)
                 if len(batch) == self.batch_size:
+                    if self.gate is not None:
+                        self.gate.wait()
                     with self.timer.stage("collate"):
                         out = self.collate_fn(batch)
                     batch = []

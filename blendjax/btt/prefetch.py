@@ -15,6 +15,8 @@ shard and ``make_array_from_process_local_data`` assembles the global array.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import queue
 import threading
 
@@ -24,6 +26,59 @@ import numpy as np
 from blendjax.utils.timing import StageTimer
 
 _SENTINEL = object()
+
+
+class TransferGate:
+    """Pauses feed workers while a host->device transfer is in flight.
+
+    On core-starved hosts (TPU-VM sidecars, CI containers) the tunnel/PCIe
+    client that pumps ``device_put`` shares its core with the collate and
+    recv threads; any concurrently running Python thread then stretches the
+    transfer by GIL-handoff latency (measured on a 1-core host: 9.8 MB
+    batch 5.5 ms alone vs 33.8 ms with one numpy thread running — ~6x).
+    Serializing the two is strictly cheaper there: the gate closes for the
+    duration of each transfer and feed workers block at their next batch
+    boundary instead of stealing the core.
+
+    On hosts with cores to spare the gate stays open permanently
+    (``JaxStream(transfer_gate='auto')``) and costs one Event check per
+    batch.
+    """
+
+    def __init__(self):
+        self._open = threading.Event()
+        self._open.set()
+
+    def wait(self, timeout=5.0):
+        """Feed-worker side: block while a transfer is in flight.  The
+        timeout is a liveness backstop — a crashed transfer thread must
+        not freeze the feed forever."""
+        self._open.wait(timeout)
+
+    @contextlib.contextmanager
+    def transfer(self):
+        """Transfer side: close the gate for the duration of the block."""
+        self._open.clear()
+        try:
+            yield
+        finally:
+            self._open.set()
+
+
+def _resolve_gate(transfer_gate, num_workers):
+    """'auto' enables the gate only where serializing wins: a non-cpu
+    backend (there is a real transfer engine to protect) on a host whose
+    cores are outnumbered by feed threads + the transfer pump."""
+    if transfer_gate == "auto":
+        cores = os.cpu_count() or 1
+        if cores <= num_workers + 1 and jax.default_backend() != "cpu":
+            return TransferGate()
+        return None
+    if transfer_gate is True:
+        return TransferGate()
+    if transfer_gate in (False, None):
+        return None
+    return transfer_gate  # caller-supplied gate (shared across streams)
 
 
 def put_batch(batch, sharding=None):
@@ -53,7 +108,8 @@ def put_batch(batch, sharding=None):
     return jax.device_put(batch, sharding)
 
 
-def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None):
+def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None,
+                    gate=None):
     """Wrap ``iterator`` (host batches) into an iterator of device batches.
 
     Params
@@ -67,6 +123,10 @@ def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None)
         Host-side pre-transfer hook (key selection, dtype cast, layout).
     timer: StageTimer | None
         Records ``device_put`` stage times.
+    gate: TransferGate | None
+        When set, the gate is held closed for each transfer (including its
+        completion, so the pump owns the core end to end) — see
+        :class:`TransferGate`.
     """
     if size < 1:
         raise ValueError("prefetch size must be >= 1")
@@ -82,7 +142,14 @@ def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None)
                 if transform is not None:
                     batch = transform(batch)
                 with timer.stage("device_put"):
-                    dev_batch = put_batch(batch, sharding)
+                    if gate is not None:
+                        with gate.transfer():
+                            dev_batch = put_batch(batch, sharding)
+                            # the gate must stay closed until the bytes have
+                            # actually landed, not just been dispatched
+                            jax.block_until_ready(dev_batch)
+                    else:
+                        dev_batch = put_batch(batch, sharding)
                 while True:
                     try:
                         q.put(dev_batch, timeout=0.5)
@@ -142,9 +209,11 @@ class JaxStream:
         drop_last=True,
         collate_fn=None,
         timer=None,
+        transfer_gate="auto",
     ):
         from blendjax.btt.loader import BatchLoader
 
+        self.gate = _resolve_gate(transfer_gate, num_workers)
         self.loader = BatchLoader(
             dataset,
             batch_size,
@@ -153,6 +222,7 @@ class JaxStream:
             drop_last=drop_last,
             collate_fn=collate_fn,
             timer=timer,
+            gate=self.gate,
         )
         self.sharding = sharding
         self.transform = transform
@@ -176,6 +246,7 @@ class JaxStream:
             sharding=self.sharding,
             transform=self.transform,
             timer=self.timer,
+            gate=self.gate,
         )
 
     def close(self):

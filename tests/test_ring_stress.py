@@ -130,9 +130,18 @@ def _run_churn(env, max_seconds=45.0, n_producers=3):
 
     last_frame = {}  # btid -> (gen, frameid) high-water mark
     n = 0
-    ds = RemoteIterableDataset(addrs, max_items=10**9, timeoutms=30000)
+    ds = RemoteIterableDataset(addrs, max_items=10**9, timeoutms=60000)
     loader = BatchLoader(ds, batch_size=8, num_workers=2)
     try:
+        # all producers must have created their rings before the first
+        # consume: on a contended 1-core host (full-suite run) the three
+        # child interpreters can take tens of seconds to start
+        deadline = time.monotonic() + 90
+        while (
+            any(_ring_ino(a) is None for a in addrs)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
         it = iter(loader)
         next(it)  # all rings up before the killing starts
         kt.start()
@@ -159,7 +168,8 @@ def _run_churn(env, max_seconds=45.0, n_producers=3):
                 n += 1
     finally:
         stop.set()
-        kt.join(timeout=5)
+        if kt.ident is not None:  # joining an unstarted thread raises a
+            kt.join(timeout=5)    # RuntimeError that masks the real failure
         loader.close()
         for p in procs:
             p.kill()
