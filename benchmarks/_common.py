@@ -64,8 +64,14 @@ class Producers:
                 unlink_address(a)
 
 
-def launch_fleet(n, extra, tag, *, transport, raw, ring_nonce, env):
-    """Spawn ``n`` ``stream_producer.py`` processes; returns Producers."""
+def launch_fleet(n, extra, tag, *, transport, raw, ring_nonce, env, nice=10):
+    """Spawn ``n`` ``stream_producer.py`` processes; returns Producers.
+
+    Producers run at ``nice`` +10 by default: on a 1-core host they are
+    pure contention for the consumer/tunnel-pump whenever the ring has
+    space, and backpressure (the blocking ring writer) keeps them fed
+    regardless of priority — deprioritizing them shortens transfer tails
+    without starving the stream."""
     from benchmarks.benchmark import free_port
 
     addrs, procs = [], []
@@ -79,6 +85,9 @@ def launch_fleet(n, extra, tag, *, transport, raw, ring_nonce, env):
             os.path.join(HERE, "stream_producer.py"),
             "--addr", addr, "--btid", str(i),
         ] + extra + (["--raw"] if raw else [])
-        procs.append(subprocess.Popen(cmd, env=env))
+        procs.append(subprocess.Popen(
+            cmd, env=env,
+            preexec_fn=(lambda lvl=nice: os.nice(lvl)) if nice else None,
+        ))
         addrs.append(addr)
     return Producers(addrs, procs, transport)

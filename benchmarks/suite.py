@@ -203,8 +203,16 @@ def main(argv=None):
     ap.add_argument("--prefetch", type=int, default=12)
     ap.add_argument("--max-inflight", type=int, default=8)
     ap.add_argument("--host-seconds", type=float, default=6.0)
-    ap.add_argument("--hbm-seconds", type=float, default=8.0)
-    ap.add_argument("--train-seconds", type=float, default=15.0)
+    ap.add_argument("--hbm-seconds", type=float, default=4.0,
+                    help="seconds per stream->HBM window")
+    ap.add_argument("--train-seconds", type=float, default=5.0,
+                    help="seconds per stream->train window")
+    ap.add_argument("--windows", type=int, default=3)
+    ap.add_argument("--fence-every", type=int, default=8)
+    ap.add_argument("--attn", choices=["auto", "full", "flash"],
+                    default="auto")
+    ap.add_argument("--moe-dispatch", choices=["sort", "scatter"],
+                    default="sort")
     ap.add_argument("--transport", choices=["tcp", "shm"], default="tcp")
     ap.add_argument("--raw", action="store_true", default=True)
     ap.add_argument("--pickle", dest="raw", action="store_false")
@@ -248,6 +256,10 @@ def main(argv=None):
 
     env = child_env()
     env["JAX_PLATFORMS"] = "cpu"  # producers never touch the accelerator
+    # a dead tunnel relay hangs `import jax` in ANY process whose env
+    # still carries the axon-plugin trigger (observed round 4); strip it
+    # from every cpu-only child so relay outages can't stall the suite
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     launch = make_launcher(args, env)
 
     def device_cmd(extra):
@@ -274,6 +286,10 @@ def main(argv=None):
             "--n-layers", str(args.n_layers),
             "--moe-experts", str(args.moe_experts),
             "--moe-topk", str(args.moe_topk),
+            "--moe-dispatch", args.moe_dispatch,
+            "--windows", str(args.windows),
+            "--fence-every", str(args.fence_every),
+            "--attn", args.attn,
         ]
         cmd += ["--raw"] if args.raw else ["--pickle"]
         if args.skip_seqformer:
@@ -326,6 +342,7 @@ def main(argv=None):
                       "child (device child left running)"})
         cpu_env = dict(dev_env)
         cpu_env["JAX_PLATFORMS"] = "cpu"
+        cpu_env.pop("PALLAS_AXON_POOL_IPS", None)  # see producer env note
         # the fault-injection hook models the ACCELERATOR backend hanging;
         # the cpu fallback never touches that backend
         cpu_env.pop("BJX_FAKE_SLOW_INIT_S", None)

@@ -3,24 +3,45 @@ needs it.
 
 Spawned by ``benchmarks/suite.py`` (which never imports jax) so that slow
 TPU backend initialization cannot block the host-side phases or zero the
-artifact: round 2's bench died because *everything* — producer launch, all
-phases, even the first diagnostic — was serialized behind ``jax.devices()``
-on a tunneled TPU whose init exceeded the entire 430 s budget (VERDICT r2
-weak #1).  This child:
+artifact (round-2 post-mortem; see suite.py's module docstring).  This
+child emits ``device_init_start`` / ``device_init`` diagnostics around
+backend bring-up, then runs the jax phases cheapest-first, each emitted
+the moment it completes.
 
-1. emits ``{"phase": "device_init_start"}`` before touching jax,
-2. emits ``{"phase": "device_init", "seconds": ...}`` the moment
-   ``jax.devices()`` returns — the diagnostic that proves where time went,
-3. then runs the jax phases, cheapest first, each emitted the moment it
-   completes: ``stream_to_hbm``, ``stream_to_train``, ``seqformer_train``,
-   and ``moe_compare`` (routed top-k vs dense MLP at the same config —
-   VERDICT r2 task #4).
+Measurement methodology (rewritten in round 4 — VERDICT r3 weak #1/#2):
 
-Every phase line carries ``platform``/``device_kind`` so the parent and
-driver can tell a TPU measurement from a CPU fallback.  ``--config small``
-shrinks the seqformer so a CPU run still completes a real streaming
-window (validating the duty-cycle methodology end-to-end, VERDICT r2
-weak #4) instead of reporting step-only numbers.
+- **Fences.**  On the tunneled ``axon`` backend ``jax.block_until_ready``
+  is a *phantom* fence: it returns when the local client has buffered the
+  op, not when the device finished it (a single 4096^3 bf16 matmul
+  "completes" in 0.04 ms — 18x the chip's peak; transfers "complete" at
+  4 GB/s through a ~12 MB/s wire).  Every r03 number timed with it was
+  fiction.  The only fence valid everywhere is a VALUE FETCH — data
+  cannot be produced before the compute that makes it.  All timing below
+  fences with ``_fetch_scalar``; ``phase_fence_validation`` re-proves
+  fence validity against known-FLOPs chained matmuls every run and the
+  verdict is carried in the artifact.
+- **Step times** come from differential chain timing: dispatch N1 then N2
+  state-threaded steps, value-fence each chain, ``step_s =
+  (T2-T1)/(N2-N1)``.  The tunnel's ~70 ms dispatch->completion latency
+  cancels in the difference.  Per-step python dispatch cost is measured
+  alongside; when it rivals the step itself the result is flagged
+  ``dispatch_bound`` (the chip could go faster; this host can't drive it
+  faster).
+- **Streams** fence with a chained on-device accumulator (stream->HBM) or
+  the train-state chain itself (stream->train), fetched every
+  ``--fence-every`` batches and at window close, so a window's elapsed
+  time covers every byte actually landed and every step actually retired.
+- **Windows.**  Every phase measures >=1 windows (``--windows``, default
+  3) and reports min/median/max (VERDICT r3 next #5); the headline value
+  is the median.
+- **MFU** is computed from closed-form analytic FLOP counts
+  (``models/*.train_flops``) cross-checked against XLA's
+  ``cost_analysis()``; both counts are reported.  A computed throughput
+  above the chip's peak is flagged ``mfu_invalid`` — never clamped
+  (VERDICT r3 weak #2).
+- ``phase_tunnel_canary`` measures the wire itself (fenced put bandwidth
+  + dispatch RTT) so the artifact carries the environmental bound the
+  stream phases run against.
 """
 
 from __future__ import annotations
@@ -28,6 +49,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -80,15 +102,32 @@ def peak_flops():
     return None, kind
 
 
+def _fetch_scalar(x):
+    """THE timing fence: fetch a scalar's value to the host.  Valid on
+    every backend — the value cannot arrive before the compute (and every
+    transfer it depends on) actually finished.  ``block_until_ready`` is
+    NOT used for timing anywhere in this suite (see module docstring)."""
+    return float(np.asarray(x))
+
+
+def _stats(values, scale=1.0, nd=2):
+    vs = sorted(v * scale for v in values)
+    return {
+        "min": round(vs[0], nd),
+        "median": round(vs[len(vs) // 2], nd),
+        "max": round(vs[-1], nd),
+        "n": len(vs),
+    }
+
+
 def step_flops(jitted, budget, *example_args):
-    """FLOPs of one compiled step, from XLA's own cost model.
+    """FLOPs of one compiled step, from XLA's own cost model — reported
+    alongside (never instead of) the closed-form analytic count.
 
     ``lower().compile()`` is a SECOND full compile of the step; skip it
-    when the remaining budget is thin — on a remote-compile backend this
-    is expensive exactly when time is scarcest (VERDICT r2 weak #4/next
-    #1d).  The persistent compilation cache usually makes it cheap on
-    repeat runs, but the budget guard must not bet on that.
-    """
+    when the remaining budget is thin.  The persistent compilation cache
+    usually makes it cheap on repeat runs, but the budget guard must not
+    bet on that."""
     if not budget.has(45, "step_flops (second compile)"):
         return None
     try:
@@ -102,73 +141,286 @@ def step_flops(jitted, budget, *example_args):
         return None
 
 
-def _measure_stream(stream, window_s, warmup_batches, batch_size,
-                    train_step=None, state=None, step_s=None, max_inflight=8):
-    """Iterate a JaxStream for ``window_s`` after warmup; async train
-    dispatch with a bounded in-flight window.  Returns (result, state)."""
-    import jax
-    from collections import deque
+def measure_step_time(train_step, state, batch, budget, windows=3,
+                      target_chain_s=1.5):
+    """Differential-chain step time with value fences.
 
-    inflight = deque()
+    Dispatches ``n1`` then ``n2`` state-threaded steps (the chain's data
+    dependency forces serial execution), value-fences each chain, and
+    reports ``(T2 - T1) / (n2 - n1)`` — the tunnel's fixed dispatch->
+    completion latency cancels.  Repeats for ``windows`` samples
+    (min/median/max).  Also times the python dispatch call alone: when
+    dispatch rivals the step, the measurement is an honest *sustained
+    from this host* number, flagged ``dispatch_bound``.
+
+    Returns ``(stats_dict, state)``.
+    """
+    t_warm0 = time.perf_counter()
+    state, loss = train_step(state, batch)
+    _fetch_scalar(loss)  # compile + warm, full roundtrip
+    warm_s = time.perf_counter() - t_warm0
+
+    def chain(n):
+        nonlocal state
+        loss = None
+        t0 = time.perf_counter()
+        dispatch = 0.0
+        for _ in range(n):
+            tD = time.perf_counter()
+            state, loss = train_step(state, batch)
+            dispatch += time.perf_counter() - tD
+        _fetch_scalar(loss)
+        return time.perf_counter() - t0, dispatch / n
+
+    n1 = 3
+    t1, d1 = chain(n1)
+    # estimate one step to size n2 so a chain costs ~target_chain_s
+    est = max((t1 - 0.05) / n1, d1, 1e-4)
+    n2 = n1 + int(max(8, min(256, target_chain_s / est)))
+    samples, dispatch_ms = [], []
+    for _ in range(windows):
+        if samples and not budget.has(
+            (t1 / n1) * (n1 + n2) + 1.0, "step-time window"
+        ):
+            break
+        t1, d1 = chain(n1)
+        t2, d2 = chain(n2)
+        samples.append(max((t2 - t1) / (n2 - n1), 1e-7))
+        dispatch_ms.append(d2 * 1e3)
+    step_s = statistics.median(samples)
+    disp = statistics.median(dispatch_ms)
+    return {
+        "step_s": round(step_s, 6),
+        "step_ms_windows": _stats(samples, 1e3, 3),
+        "dispatch_ms": round(disp, 3),
+        "dispatch_bound": disp >= 0.8 * step_s * 1e3,
+        "chain": [n1, n2],
+        "warmup_s": round(warm_s, 1),
+        "fence": "value_fetch",
+    }, state
+
+
+def flops_report(entry, step_s, flops_xla, flops_analytic, peak):
+    """Attach FLOP/MFU fields; flag — never clamp — impossible readings
+    (VERDICT r3 weak #2)."""
+    if flops_xla:
+        entry["step_flops_xla"] = flops_xla
+    if flops_analytic:
+        entry["step_flops_analytic"] = round(flops_analytic)
+    if flops_xla and flops_analytic:
+        entry["flops_xla_over_analytic"] = round(flops_xla / flops_analytic, 3)
+    flops = flops_analytic or flops_xla
+    if not flops or not step_s:
+        return entry
+    fps = flops / step_s
+    entry["model_flops_per_sec"] = round(fps, 1)
+    if peak:
+        mfu = fps / peak
+        entry["mfu"] = round(mfu, 4)
+        if mfu > 1.02:
+            entry["mfu_invalid"] = True
+            entry["mfu_diagnostic"] = (
+                "computed throughput exceeds device peak — step time or "
+                "FLOP count is wrong; do not trust this row"
+            )
+    return entry
+
+
+def _measure_stream(stream, window_s, warmup_batches, batch_size,
+                    train_step=None, state=None, step_s=None,
+                    fence_every=8, windows=3, budget=None):
+    """Iterate a JaxStream for ``windows`` windows of ``window_s`` each.
+
+    Every window's elapsed time includes a closing value fence, so it
+    covers every transfer and step the window dispatched — on a backend
+    that buffers asynchronously (axon) the un-fenced r03 version measured
+    local buffering, not the wire.  The stream's StageTimer is reset at
+    each window open so the stage summary (recv/collate/device_put from
+    the feed threads + this loop's feed_wait/dispatch/fence) maps 1:1
+    onto that window.  Returns (result, state).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    timer = stream.timer
+
+    @jax.jit
+    def fence_add(acc, b):
+        return acc + sum(
+            jnp.mean(leaf.astype(jnp.float32)) for leaf in jax.tree.leaves(b)
+        )
+
+    acc = jnp.float32(0.0)
+    last_loss = None
+
+    def sync():
+        if last_loss is not None:
+            _fetch_scalar(last_loss)
+        else:
+            _fetch_scalar(acc)
+
     it = iter(stream)
-    t0 = None
-    measured = 0
+    results = []
+    exhausted = False
     try:
-        for batch in it:
+        # warmup: first batches compile fence_add / prime the feed
+        for _ in range(max(1, warmup_batches)):
+            try:
+                batch = next(it)
+            except StopIteration:
+                raise RuntimeError("stream ended during warmup")
             if train_step is not None:
-                state, loss = train_step(state, batch)
-                inflight.append(loss)
-                if len(inflight) > max_inflight:
-                    jax.block_until_ready(inflight.popleft())
+                state, last_loss = train_step(state, batch)
             else:
-                jax.block_until_ready(jax.tree.leaves(batch)[0])
-            if t0 is None:
-                warmup_batches -= 1
-                if warmup_batches <= 0:
-                    t0 = time.perf_counter()
-                continue
-            measured += 1
-            if time.perf_counter() - t0 >= window_s:
+                acc = fence_add(acc, batch)
+        sync()
+
+        for _w in range(windows):
+            if results and budget is not None and not budget.has(
+                window_s + 5, "stream window"
+            ):
                 break
-        while inflight:  # queued steps must finish inside the window
-            jax.block_until_ready(inflight.popleft())
-        # window closes here — before it.close(), whose prefetch-thread
-        # teardown (up to ~5s) must not be billed to the measurement
-        elapsed = time.perf_counter() - t0 if t0 is not None else None
+            timer.reset()
+            t0 = time.perf_counter()
+            measured = 0
+            since_fence = 0
+            while True:
+                with timer.stage("feed_wait"):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                with timer.stage("dispatch"):
+                    if train_step is not None:
+                        state, last_loss = train_step(state, batch)
+                    else:
+                        acc = fence_add(acc, batch)
+                measured += 1
+                since_fence += 1
+                if since_fence >= fence_every:
+                    with timer.stage("fence"):
+                        sync()
+                    since_fence = 0
+                if time.perf_counter() - t0 >= window_s:
+                    break
+            with timer.stage("fence"):
+                sync()  # bill every outstanding transfer/step to the window
+            elapsed = time.perf_counter() - t0
+            if measured:
+                results.append({
+                    "batches": measured,
+                    "elapsed_s": round(elapsed, 3),
+                    "items_per_sec": round(measured * batch_size / elapsed, 2),
+                    "batches_per_sec": round(measured / elapsed, 2),
+                    "stages": timer.summary(),
+                })
+            if exhausted:
+                break
     finally:
         it.close()
-    if t0 is None or measured == 0:
+    if not results:
         raise RuntimeError("no measured batches")
+    mid = sorted(results, key=lambda r: r["items_per_sec"])[len(results) // 2]
     out = {
-        "batches": measured,
-        "elapsed_s": round(elapsed, 3),
-        "items_per_sec": round(measured * batch_size / elapsed, 2),
-        "batches_per_sec": round(measured / elapsed, 2),
+        "batches": mid["batches"],
+        "elapsed_s": mid["elapsed_s"],
+        "items_per_sec": mid["items_per_sec"],
+        "batches_per_sec": mid["batches_per_sec"],
+        "items_per_sec_windows": _stats(
+            [r["items_per_sec"] for r in results]
+        ),
+        "stages": mid["stages"],
+        "fence": "value_fetch",
+        "fence_every": fence_every,
     }
     if step_s is not None:
         out["step_s"] = round(step_s, 6)
         out["train_duty_cycle"] = round(
-            min(1.0, measured * step_s / elapsed), 4
+            min(1.0, mid["batches"] * step_s / mid["elapsed_s"]), 4
         )
     return out, state
 
 
-def _pure_step_time(train_step, state, batch):
-    """Back-to-back step time on a held device batch (state donated and
-    threaded through, exactly as in training).  Reps adapt to the first
-    step's cost so a slow backend (CPU fallback) can't eat the budget."""
-    import jax
+# ---------------------------------------------------------------------------
+# phases
+# ---------------------------------------------------------------------------
 
-    t0 = time.perf_counter()
-    state, loss = train_step(state, batch)  # ensure compiled/warm
-    jax.block_until_ready(loss)
-    first = time.perf_counter() - t0
-    reps = max(2, min(10, int(3.0 / max(first, 1e-4))))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        state, loss = train_step(state, batch)
-    jax.block_until_ready(loss)
-    return (time.perf_counter() - t0) / reps, state
+
+def phase_fence_validation(args, budget, tag):
+    """Prove (or disprove) fence validity against known-FLOPs matmuls —
+    the check that caught round 3's phantom ``block_until_ready``.  TPU
+    only: the closed-form peak table has no CPU entry, and the 4096^3
+    probe matmul would eat a CPU child's whole budget."""
+    if tag["platform"] != "tpu" or not budget.has(20, "fence_validation"):
+        return
+    from benchmarks.timing_calibration import calibrate
+
+    peak, kind = peak_flops()
+    if peak is None:
+        return
+    # failures propagate to main()'s phase wrapper — one handler, like
+    # every other phase
+    fence_ok, rows = calibrate(peak, quick=True)
+    emit({"phase": "fence_validation", "fence_ok": fence_ok,
+          "fence_used": "value_fetch", "cases": rows, **tag})
+    if not fence_ok.get("fetch", True):
+        note("value-fetch fence itself reads above peak — all timings "
+             "suspect this run")
+
+
+def phase_tunnel_canary(args, budget, tag):
+    """Measure the wire itself: value-fenced host->device bandwidth on one
+    cube batch, and the dispatch->completion RTT of a trivial jit op.
+    The stream phases' ceiling is ``put_mb_per_s / batch_mb`` batches/sec
+    regardless of what the rest of the pipeline does; carrying the canary
+    in the artifact makes that bound explicit per run."""
+    if not budget.has(15, "tunnel_canary"):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    batch = rng.integers(
+        0, 255, (args.batch, args.height, args.width, args.channels),
+        dtype=np.uint8,
+    )
+    mb = batch.nbytes / 1e6
+
+    fsum = jax.jit(lambda x: jnp.mean(x.astype(jnp.float32)))
+    fadd = jax.jit(lambda x: x + 1.0)
+    one = jax.device_put(np.float32(1.0))
+    _fetch_scalar(fadd(one))  # compile
+    rtts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _fetch_scalar(fadd(one))
+        rtts.append(time.perf_counter() - t0)
+
+    _fetch_scalar(fsum(jax.device_put(batch)))  # compile
+    puts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        d = jax.device_put(batch)
+        _fetch_scalar(fsum(d))
+        puts.append(time.perf_counter() - t0)
+        del d
+    # each timed put pays one dispatch->fetch RTT the stream phases
+    # amortize over fence_every batches; subtract it so put_mb_per_s is
+    # a true wire ceiling (raw samples reported alongside) — otherwise a
+    # healthy pipeline could measure above the "ceiling"
+    rtt_med = statistics.median(rtts)
+    wire = [max(p - rtt_med, 1e-3) for p in puts]
+    emit({
+        "phase": "tunnel_canary",
+        "rtt_ms": _stats(rtts, 1e3),
+        "batch_mb": round(mb, 2),
+        "put_s": _stats(puts, 1.0, 3),
+        "put_mb_per_s": round(mb / statistics.median(wire), 1),
+        "put_mb_per_s_raw": round(mb / statistics.median(puts), 1),
+        "fence": "value_fetch",
+        **tag,
+    })
 
 
 def phase_cube_stream(args, budget, producers, tag):
@@ -203,24 +455,25 @@ def phase_cube_stream(args, budget, producers, tag):
 
     # -- phase 1: stream -> HBM ------------------------------------------
     # Windows shrink when the budget is thin (e.g. slow backend init ate
-    # most of it): a 3 s TPU-fed window beats a skipped phase.
-    hbm_window = min(args.hbm_seconds, max(3.0, budget.remaining() * 0.15))
-    if budget.has(hbm_window + 15, "stream_to_hbm"):
+    # most of it): short TPU-fed windows beat a skipped phase.
+    hbm_window = min(args.hbm_seconds, max(3.0, budget.remaining() * 0.05))
+    if budget.has(hbm_window * args.windows + 15, "stream_to_hbm"):
         stream = make_stream()
         try:
             res, _ = _measure_stream(
                 stream, hbm_window, warmup_batches=2,
-                batch_size=args.batch,
+                batch_size=args.batch, fence_every=args.fence_every,
+                windows=args.windows, budget=budget,
             )
-            res.update(phase="stream_to_hbm", stages=stream.timer.summary(),
-                       **tag)
+            res.update(phase="stream_to_hbm", **tag)
             emit(res)
         finally:
             stream.close()
 
     # -- phase 2: stream -> detector train -------------------------------
-    train_window = min(args.train_seconds, max(4.0, budget.remaining() * 0.2))
-    if not budget.has(train_window + 30, "stream_to_train"):
+    train_window = min(args.train_seconds,
+                       max(4.0, budget.remaining() * 0.08))
+    if not budget.has(train_window * args.windows + 30, "stream_to_train"):
         return
     opt = optax.adam(1e-3)
     params = detector.init(
@@ -244,22 +497,29 @@ def phase_cube_stream(args, budget, producers, tag):
         }
     )
     tC = time.perf_counter()
-    step_s, state = _pure_step_time(train_step, state, warm_batch)
-    note(f"detector compile+warm {time.perf_counter() - tC:.1f}s, "
-         f"step {step_s * 1e3:.2f}ms")
-    flops = step_flops(train_step, budget, state, warm_batch)
+    step_stats, state = measure_step_time(
+        train_step, state, warm_batch, budget, windows=args.windows
+    )
+    note(f"detector compile+warm+measure {time.perf_counter() - tC:.1f}s, "
+         f"step {step_stats['step_s'] * 1e3:.2f}ms "
+         f"(dispatch {step_stats['dispatch_ms']:.2f}ms)")
+    flops_xla = step_flops(train_step, budget, state, warm_batch)
+    flops_an = detector.train_flops(
+        args.batch, args.height, args.width, num_keypoints=8,
+        in_channels=args.channels,
+    )
 
     stream = make_stream()
     try:
         res, state = _measure_stream(
             stream, train_window, warmup_batches=2,
             batch_size=args.batch, train_step=train_step, state=state,
-            step_s=step_s, max_inflight=args.max_inflight,
+            step_s=step_stats["step_s"], fence_every=args.fence_every,
+            windows=args.windows, budget=budget,
         )
-        res.update(phase="stream_to_train", stages=stream.timer.summary(),
-                   **tag)
-        if flops:
-            res["step_flops"] = flops
+        res.update(phase="stream_to_train", step_stats=step_stats, **tag)
+        flops_report(res, step_stats["step_s"], flops_xla, flops_an,
+                     peak_flops()[0])
         emit(res)
     finally:
         stream.close()
@@ -278,19 +538,38 @@ def _seq_model(args):
     return kwargs, args.seq_batch, T
 
 
+def _resolve_attn(args, tag, T):
+    """'auto' -> the fused Pallas flash kernel on TPU when the length
+    allows it (VERDICT r3 next #4: the flagship kernel must actually run
+    compiled on the chip), full attention otherwise."""
+    if args.attn == "full" or T % 128 != 0:
+        return "full", None
+    if args.attn == "auto" and tag["platform"] != "tpu":
+        return "full", None
+    from blendjax.ops.flash_attention import make_flash_attention
+
+    # compiled kernel on TPU; interpreter elsewhere (CPU fallback child
+    # with --attn flash) so the flag degrades instead of failing
+    return "flash", make_flash_attention(
+        causal=True, interpret=tag["platform"] != "tpu"
+    )
+
+
 def phase_seqformer(args, budget, launch, tag):
     """Phase 3: MXU-bound SeqFormer world-model training on streamed
     episodes — duty cycle + MFU."""
     if not budget.has(90, "seqformer_train"):
         return
+    import functools
+
     import jax
     import optax
 
     from blendjax.btt.dataset import RemoteIterableDataset
     from blendjax.btt.prefetch import JaxStream
     from blendjax.models import seqformer
-    from blendjax.utils.timing import StageTimer
     from blendjax.models.train import TrainState, make_train_step
+    from blendjax.utils.timing import StageTimer
 
     kwargs, seq_batch, T = _seq_model(args)
     producers = launch(
@@ -303,20 +582,10 @@ def phase_seqformer(args, budget, launch, tag):
         params = seqformer.init(jax.random.PRNGKey(0), **kwargs)
         opt = optax.adam(1e-4)
         state = TrainState.create(params, opt)
+        attn_name, attn_fn = _resolve_attn(args, tag, T)
         loss_fn = seqformer.loss_fn
-        if args.attn == "flash" and T % 128 == 0:
-            import functools
-
-            from blendjax.ops.flash_attention import make_flash_attention
-
-            loss_fn = functools.partial(
-                seqformer.loss_fn,
-                # compiled kernel on TPU; interpreter elsewhere (CPU
-                # fallback child) so the flag degrades instead of failing
-                attn_fn=make_flash_attention(
-                    causal=True, interpret=tag["platform"] != "tpu"
-                ),
-            )
+        if attn_fn is not None:
+            loss_fn = functools.partial(seqformer.loss_fn, attn_fn=attn_fn)
         train_step = make_train_step(loss_fn, opt)
 
         rng = np.random.default_rng(0)
@@ -327,25 +596,46 @@ def phase_seqformer(args, budget, launch, tag):
         )
         warm_dev = jax.device_put(warm)
         tC = time.perf_counter()
-        step_s, state = _pure_step_time(train_step, state, warm_dev)
-        note(f"seqformer compile+warm {time.perf_counter() - tC:.1f}s, "
-             f"step {step_s * 1e3:.1f}ms")
-        flops = step_flops(train_step, budget, state, warm_dev)
+        try:
+            step_stats, state = measure_step_time(
+                train_step, state, warm_dev, budget, windows=args.windows
+            )
+        except Exception as e:  # noqa: BLE001 - flash compile may fail on
+            # an untested backend: degrade to full attention, with a note
+            if attn_name != "flash":
+                raise
+            note(f"flash attention failed ({type(e).__name__}: {e}); "
+                 "falling back to full attention")
+            attn_name = "full (flash failed)"
+            train_step = make_train_step(seqformer.loss_fn, opt)
+            # re-init: an async runtime failure surfaces at the fence,
+            # AFTER the attempted step already donated `params`' buffers
+            params = seqformer.init(jax.random.PRNGKey(0), **kwargs)
+            state = TrainState.create(params, opt)
+            step_stats, state = measure_step_time(
+                train_step, state, warm_dev, budget, windows=args.windows
+            )
+        note(f"seqformer[{attn_name}] compile+warm+measure "
+             f"{time.perf_counter() - tC:.1f}s, "
+             f"step {step_stats['step_s'] * 1e3:.1f}ms")
+        step_s = step_stats["step_s"]
+        flops_xla = step_flops(train_step, budget, state, warm_dev)
+        flops_an = seqformer.train_flops(
+            seq_batch, T, args.obs_dim, args.d_model, args.n_heads,
+            args.n_layers,
+        )
         peak, kind = peak_flops()
 
+        base = {"phase": "seqformer_train", "attn": attn_name,
+                "device_kind": kind, "step_stats": step_stats, **tag}
         if step_s * 30 > budget.remaining():
             # step too slow for a streaming window in the time left (e.g.
             # MXU-sized model on a CPU fallback): report the step numbers
-            out = {"phase": "seqformer_train", "batches": 0,
-                   "step_s": round(step_s, 6), "device_kind": kind,
-                   "window_skipped": True, **tag}
-            if flops:
-                out["step_flops"] = flops
-                out["model_flops_per_sec"] = round(flops / step_s, 1)
-                if peak:
-                    out["mfu"] = round(min(1.0, (flops / step_s) / peak), 4)
-            emit(out)
+            out = {**base, "batches": 0, "step_s": round(step_s, 6),
+                   "window_skipped": True}
+            emit(flops_report(out, step_s, flops_xla, flops_an, peak))
             return
+
         def transform(batch):
             return seqformer.make_episode_batch(batch["obs_seq"])
 
@@ -365,36 +655,28 @@ def phase_seqformer(args, budget, launch, tag):
             res, state = _measure_stream(
                 stream, args.train_seconds, warmup_batches=2,
                 batch_size=seq_batch, train_step=train_step,
-                state=state, step_s=step_s, max_inflight=args.max_inflight,
+                state=state, step_s=step_s, fence_every=args.fence_every,
+                windows=args.windows, budget=budget,
             )
         finally:
             stream.close()
-        res.update(
-            phase="seqformer_train",
-            stages=stream.timer.summary(),
-            tokens_per_sec=round(res["batches_per_sec"] * seq_batch * T, 1),
-            device_kind=kind,
-            **tag,
-        )
-        if flops:
-            res["step_flops"] = flops
-            res["model_flops_per_sec"] = round(flops / res["step_s"], 1)
-            if peak:
-                res["mfu"] = round(
-                    min(1.0, (flops / res["step_s"]) / peak), 4
-                )
-        emit(res)
+        res.update(base)
+        res["tokens_per_sec"] = round(res["batches_per_sec"] * seq_batch * T, 1)
+        emit(flops_report(res, step_s, flops_xla, flops_an, peak))
     finally:
         producers.close()
 
 
 def phase_moe_compare(args, budget, tag):
-    """Phase 4: routed top-k MoE vs dense MLP at the same seqformer config
-    (VERDICT r2 task #4) — held-batch step times, no stream (the question
-    is MXU arithmetic, not the feed).  Reports per-variant step time, MFU
-    and the routed dispatch fraction."""
+    """Phase 4: routed top-k MoE vs dense mixture vs plain MLP at the same
+    seqformer config (VERDICT r2 task #4) — held-batch differential step
+    times, no stream (the question is MXU arithmetic, not the feed).
+    Reports per-variant step time, both FLOP counts, unclamped MFU, and
+    the MEASURED dispatch fraction from the routing itself."""
     if not budget.has(75, "moe_compare"):
         return
+    import functools
+
     import jax
     import optax
 
@@ -411,60 +693,80 @@ def phase_moe_compare(args, budget, tag):
     )
     warm_dev = jax.device_put(warm)
     out = {"phase": "moe_compare", "device_kind": kind,
-           "experts": args.moe_experts, "top_k": args.moe_topk, **tag}
+           "experts": args.moe_experts, "top_k": args.moe_topk,
+           "moe_dispatch": args.moe_dispatch, **tag}
     # three-way: plain MLP (no experts), dense soft mixture (EVERY expert
     # evaluated — the r1 design routed top-k replaces), routed top-k.
     # The verdict's bar is topk <= dense at e=8, k=2: routed computes
     # k*capacity_factor expert-passes per token vs the mixture's e.
-    import functools
-
     for variant in ("mlp", "dense", "topk"):
         if not budget.has(30, f"moe_compare[{variant}]"):
             out[variant] = {"skipped": True}
             continue
         vkw = dict(kwargs)
         loss = seqformer.loss_fn
+        fkw = {}
         if variant == "dense":
             vkw["n_experts"] = args.moe_experts
             loss = functools.partial(seqformer.loss_fn, moe_impl="dense")
+            fkw = dict(n_experts=args.moe_experts, moe_impl="dense")
         elif variant == "topk":
             vkw["n_experts"] = args.moe_experts
             loss = functools.partial(
                 seqformer.loss_fn, moe_impl="topk", moe_k=args.moe_topk,
-                moe_aux_weight=0.01,
+                moe_aux_weight=0.01, moe_dispatch=args.moe_dispatch,
             )
+            fkw = dict(n_experts=args.moe_experts, moe_impl="topk",
+                       moe_k=args.moe_topk)
         params = seqformer.init(jax.random.PRNGKey(0), **vkw)
         opt = optax.adam(1e-4)
         state = TrainState.create(params, opt)
         train_step = make_train_step(loss, opt)
         tC = time.perf_counter()
         try:
-            step_s, state = _pure_step_time(train_step, state, warm_dev)
+            step_stats, state = measure_step_time(
+                train_step, state, warm_dev, budget, windows=args.windows
+            )
         except Exception as e:  # noqa: BLE001 - report partial phase
             note(f"moe_compare[{variant}] failed: {type(e).__name__}: {e}")
             out[variant] = {"error": str(e)}
             continue
-        note(f"moe[{variant}] compile+warm {time.perf_counter() - tC:.1f}s, "
-             f"step {step_s * 1e3:.1f}ms")
-        entry = {"step_s": round(step_s, 6)}
-        flops = step_flops(train_step, budget, state, warm_dev)
-        if flops:
-            entry["step_flops"] = flops
-            entry["model_flops_per_sec"] = round(flops / step_s, 1)
-            if peak:
-                entry["mfu"] = round(min(1.0, (flops / step_s) / peak), 4)
-        if variant == "topk":
-            # fraction of MLP compute actually dispatched: k/e at perfect
-            # capacity, less when tokens are dropped
-            entry["dispatch_fraction"] = round(
-                args.moe_topk / args.moe_experts, 4
-            )
+        note(f"moe[{variant}] compile+warm+measure "
+             f"{time.perf_counter() - tC:.1f}s, "
+             f"step {step_stats['step_s'] * 1e3:.1f}ms")
+        entry = {"step_s": step_stats["step_s"], "step_stats": step_stats}
+        flops_xla = step_flops(train_step, budget, state, warm_dev)
+        flops_an = seqformer.train_flops(
+            seq_batch, T, args.obs_dim, args.d_model, args.n_heads,
+            args.n_layers, **fkw,
+        )
+        flops_report(entry, step_stats["step_s"], flops_xla, flops_an, peak)
+        if variant == "topk" and budget.has(45, "moe_stats (extra compile)"):
+            # the MEASURED fraction of (token, choice) assignments that
+            # won a capacity slot — not the analytic k/e bound
+            stats_fn = jax.jit(functools.partial(
+                seqformer.moe_stats, moe_k=args.moe_topk,
+                moe_dispatch=args.moe_dispatch,
+            ))
+            try:
+                st = stats_fn(state.params, warm_dev)
+                entry["dispatch_fraction_measured"] = round(
+                    _fetch_scalar(st["dispatch_fraction"]), 4
+                )
+            except Exception as e:  # noqa: BLE001
+                note(f"moe_stats failed: {e}")
         out[variant] = entry
     # NOTE key rename vs rounds <=2: 'dense' was previously the plain MLP;
     # it now means the every-expert soft mixture, and the ratio key says so
     if "step_s" in out.get("dense", {}) and "step_s" in out.get("topk", {}):
         out["topk_over_dense_mixture"] = round(
             out["topk"]["step_s"] / out["dense"]["step_s"], 4
+        )
+    # sanity that r3's phantom fences failed: dense (e experts) must cost
+    # at least the plain MLP
+    if "step_s" in out.get("dense", {}) and "step_s" in out.get("mlp", {}):
+        out["consistent_dense_ge_mlp"] = (
+            out["dense"]["step_s"] >= out["mlp"]["step_s"]
         )
     emit(out)
 
@@ -497,9 +799,18 @@ def main(argv=None):
     ap.add_argument("--height", type=int, default=480)
     ap.add_argument("--channels", type=int, default=4)
     ap.add_argument("--prefetch", type=int, default=12)
-    ap.add_argument("--max-inflight", type=int, default=8)
-    ap.add_argument("--hbm-seconds", type=float, default=8.0)
-    ap.add_argument("--train-seconds", type=float, default=15.0)
+    ap.add_argument("--max-inflight", type=int, default=8,
+                    help="unused since the round-4 fence rewrite "
+                         "(accepted for CLI compatibility)")
+    ap.add_argument("--windows", type=int, default=3,
+                    help="measurement windows per phase; the artifact "
+                         "reports min/median/max and the median leads")
+    ap.add_argument("--fence-every", type=int, default=8,
+                    help="stream batches between mid-window value fences")
+    ap.add_argument("--hbm-seconds", type=float, default=4.0,
+                    help="seconds per stream->HBM window")
+    ap.add_argument("--train-seconds", type=float, default=5.0,
+                    help="seconds per stream->train window")
     ap.add_argument("--transport", choices=["tcp", "shm"], default="tcp")
     ap.add_argument("--raw", action="store_true", default=True)
     ap.add_argument("--pickle", dest="raw", action="store_false")
@@ -515,13 +826,18 @@ def main(argv=None):
     ap.add_argument("--d-model", type=int, default=1024)
     ap.add_argument("--n-heads", type=int, default=8)
     ap.add_argument("--n-layers", type=int, default=8)
-    ap.add_argument("--attn", choices=["full", "flash"], default="full",
-                    help="seqformer attention: 'flash' uses the fused "
-                         "Pallas kernel (needs seq_len-1 divisible by 128)")
+    ap.add_argument("--attn", choices=["auto", "full", "flash"],
+                    default="auto",
+                    help="seqformer attention: 'flash' is the fused "
+                         "Pallas kernel (needs seq_len-1 divisible by "
+                         "128); 'auto' picks flash on TPU")
     ap.add_argument("--skip-seqformer", action="store_true")
     ap.add_argument("--skip-moe", action="store_true")
     ap.add_argument("--moe-experts", type=int, default=8)
     ap.add_argument("--moe-topk", type=int, default=2)
+    ap.add_argument("--moe-dispatch", choices=["sort", "scatter"],
+                    default="sort",
+                    help="routed MoE dispatch algorithm (models/moe.py)")
     ap.add_argument("--ring-nonce", default=str(os.getpid()),
                     help="embedded in shm ring names; the parent passes its "
                          "own pid so its leak sweep finds our rings")
@@ -574,18 +890,31 @@ def main(argv=None):
     if args.wait_go:
         sys.stdin.readline()  # parent's go (EOF if the parent died: proceed)
     tag = {"platform": dev.platform, "config": args.config,
-           "width": args.width, "height": args.height}
+           "width": args.width, "height": args.height,
+           "channels": args.channels, "batch_size": args.batch}
 
     from blendjax.btt.launcher import child_env
 
     env = child_env()
     env["JAX_PLATFORMS"] = "cpu"  # producers never touch the accelerator
+    # dead-relay protection: the axon sitecustomize trigger makes any
+    # `import jax` dial the tunnel; producers must not be stallable
+    env.pop("PALLAS_AXON_POOL_IPS", None)
 
     def launch(n, extra, tag_name):
         return launch_fleet(
             n, extra, tag_name, transport=args.transport, raw=args.raw,
             ring_nonce=args.ring_nonce, env=env,
         )
+
+    try:
+        phase_fence_validation(args, budget, tag)
+    except Exception as e:  # noqa: BLE001
+        note(f"fence_validation failed: {type(e).__name__}: {e}")
+    try:
+        phase_tunnel_canary(args, budget, tag)
+    except Exception as e:  # noqa: BLE001
+        note(f"tunnel_canary failed: {type(e).__name__}: {e}")
 
     producers = launch(
         args.instances,

@@ -1,0 +1,280 @@
+"""Microbenchmark: what slows host->device transfers down during training?
+
+Each ``device_put`` here is fenced with a VALUE FETCH (jitted reduce of
+the landed batch, ``np.asarray`` of the result) — ``block_until_ready``
+is a phantom fence on the axon tunnel (it acks the local client buffer;
+see ``timing_calibration.py``), and an earlier block-fenced version of
+this probe measured 2-4 GB/s "transfers" through what the fenced path
+proves is a ~12 MB/s wire.  With honest fencing the scenarios measure
+how much of the WIRE the pump actually gets under different host-side
+contention.  Each scenario toggles one suspect:
+
+  put_alone          transfers back-to-back, nothing else running
+  put_queued_steps   8 train steps queued on the device at each put
+                     (device/tunnel ordering effect, no host concurrency)
+  put_interleaved    one async step dispatched between puts, same thread
+                     (tunnel interleaving, no GIL concurrency)
+  put_vs_dispatch    a thread dispatching steps back-to-back during puts
+                     (GIL + tunnel contention from the train loop)
+  put_vs_numpy       a thread doing collate-like numpy work during puts
+                     (GIL contention from feed workers; the r3 ~6x claim)
+  put_vs_both        both threads running — the stream_to_train picture
+
+Prints one JSON line per scenario: {scenario, n, mean_ms, p50_ms,
+min_ms, max_ms, mb_per_s}.  Run on the real TPU (axon tunnel); takes
+~20 s with a warm compile cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
+
+_FENCE = None
+
+
+def _fence_put(d):
+    """Value-fence one landed batch: jitted mean of every leaf, fetched.
+    The scalar cannot exist until every byte crossed the wire."""
+    global _FENCE
+    import jax
+    import jax.numpy as jnp
+
+    if _FENCE is None:
+        _FENCE = jax.jit(lambda b: sum(
+            jnp.mean(leaf.astype(jnp.float32)) for leaf in jax.tree.leaves(b)
+        ))
+    return float(np.asarray(_FENCE(d)))
+
+
+def timed_puts(make_batch, n, setup=None, teardown=None):
+    import jax
+
+    times = []
+    ctx = setup() if setup else None
+    try:
+        for _ in range(n):
+            b = make_batch()
+            t0 = time.perf_counter()
+            d = jax.device_put(b)
+            _fence_put(d)
+            times.append(time.perf_counter() - t0)
+            del d
+    finally:
+        if teardown:
+            teardown(ctx)
+    return times
+
+
+def report(name, times, nbytes):
+    ms = [t * 1e3 for t in times]
+    out = {
+        "scenario": name,
+        "n": len(ms),
+        "mean_ms": round(statistics.mean(ms), 2),
+        "p50_ms": round(statistics.median(ms), 2),
+        "min_ms": round(min(ms), 2),
+        "max_ms": round(max(ms), 2),
+        "mb_per_s": round(nbytes / statistics.median(times) / 1e6, 1),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main(n=6):
+    import jax
+    import optax
+
+    sys.setswitchinterval(500 / 1e6)  # suite_device.py's setting
+
+    from blendjax.models import detector
+    from blendjax.models.train import TrainState, make_train_step
+    from blendjax.ops.image import decode_frames
+
+    rng = np.random.default_rng(0)
+    shape = (8, 480, 640, 4)
+    nbytes = int(np.prod(shape)) + 8 * 8 * 2 * 4
+
+    def make_batch():
+        return {
+            "image": rng.integers(0, 255, shape, dtype=np.uint8),
+            "xy": rng.random((8, 8, 2)).astype(np.float32),
+        }
+
+    # train step identical to the bench's detector phase
+    opt = optax.adam(1e-3)
+    params = detector.init(jax.random.PRNGKey(0), num_keypoints=8,
+                           in_channels=4)
+    state = TrainState.create(params, opt)
+
+    def loss_with_decode(params, batch):
+        images = decode_frames(batch["image"], dtype=jax.numpy.bfloat16)
+        return detector.loss_fn(params, {"image": images, "xy": batch["xy"]})
+
+    train_step = make_train_step(loss_with_decode, opt)
+    warm = jax.device_put(make_batch())
+    state, loss = train_step(state, warm)
+    float(np.asarray(loss))  # value fence: compile + land the warm batch
+
+    # 1. alone ----------------------------------------------------------
+    report("put_alone", timed_puts(make_batch, n), nbytes)
+
+    # 2. steps queued on the device at each put ------------------------
+    def put_with_queue():
+        nonlocal state
+        times = []
+        for _ in range(n):
+            b = make_batch()
+            losses = []
+            for _ in range(8):
+                state, loss = train_step(state, warm)
+                losses.append(loss)
+            t0 = time.perf_counter()
+            d = jax.device_put(b)
+            _fence_put(d)
+            times.append(time.perf_counter() - t0)
+            float(np.asarray(losses[-1]))  # retire the queued chain
+        return times
+
+    report("put_queued_steps", put_with_queue(), nbytes)
+
+    # 3. one async dispatch between puts, same thread ------------------
+    def put_interleaved():
+        nonlocal state
+        times = []
+        loss = None
+        for _ in range(n):
+            b = make_batch()
+            state, loss = train_step(state, warm)
+            t0 = time.perf_counter()
+            d = jax.device_put(b)
+            _fence_put(d)
+            times.append(time.perf_counter() - t0)
+        float(np.asarray(loss))
+        return times
+
+    report("put_interleaved", put_interleaved(), nbytes)
+
+    # background workloads ---------------------------------------------
+    def dispatch_loop(stop):
+        nonlocal state
+        from collections import deque
+
+        inflight = deque()
+        while not stop.is_set():
+            state, loss = train_step(state, warm)
+            inflight.append(loss)
+            if len(inflight) > 8:
+                jax.block_until_ready(inflight.popleft())
+        jax.block_until_ready(list(inflight))
+
+    def numpy_loop(stop):
+        frames = [rng.integers(0, 255, shape[1:], dtype=np.uint8)
+                  for _ in range(8)]
+        while not stop.is_set():
+            np.stack(frames)  # collate-like: one batch assembly
+
+    def bg(*loops):
+        def setup():
+            stop = threading.Event()
+            threads = [threading.Thread(target=f, args=(stop,), daemon=True)
+                       for f in loops]
+            for t in threads:
+                t.start()
+            return stop, threads
+
+        def teardown(ctx):
+            stop, threads = ctx
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+
+        return setup, teardown
+
+    for name, loops in (
+        ("put_vs_dispatch", (dispatch_loop,)),
+        ("put_vs_numpy", (numpy_loop,)),
+        ("put_vs_both", (dispatch_loop, numpy_loop)),
+    ):
+        setup, teardown = bg(*loops)
+        report(name, timed_puts(make_batch, n, setup, teardown), nbytes)
+
+    # process-level contention: a busy sibling process (the producer's
+    # role in the bench — frame generation is a separate python process
+    # sharing the one core, invisible to GIL-only scenarios above)
+    import subprocess
+
+    def spin_proc(nice_level):
+        def setup():
+            return subprocess.Popen(
+                [sys.executable, "-c",
+                 f"import os; os.nice({nice_level})\n"
+                 "import numpy as np\n"
+                 "a = np.zeros((480, 640, 4), np.uint8)\n"
+                 "while True: b = a.copy()"],
+            )
+
+        def teardown(p):
+            p.kill()
+            p.wait()
+
+        return setup, teardown
+
+    for name, nice_level in (("put_vs_proc_nice0", 0),
+                             ("put_vs_proc_nice15", 15)):
+        setup, teardown = spin_proc(nice_level)
+        report(name, timed_puts(make_batch, n, setup, teardown), nbytes)
+
+    # everything at once, the stream_to_train picture: sibling process +
+    # dispatch thread + numpy thread
+    def all_setup(nice_level):
+        s1, t1 = bg(dispatch_loop, numpy_loop)
+        s2, t2 = spin_proc(nice_level)
+
+        def setup():
+            return (s1(), s2())
+
+        def teardown(ctx):
+            c1, c2 = ctx
+            t1(c1)
+            t2(c2)
+
+        return setup, teardown
+
+    for name, nice_level in (("put_vs_all_nice0", 0),
+                             ("put_vs_all_nice15", 15)):
+        setup, teardown = all_setup(nice_level)
+        report(name, timed_puts(make_batch, n, setup, teardown), nbytes)
+
+    # transfer granularity: 4 batches per put (39 MB) under full load
+    big_shape = (32,) + shape[1:]
+    big_bytes = int(np.prod(big_shape)) + 32 * 8 * 2 * 4
+
+    def make_big():
+        return {
+            "image": rng.integers(0, 255, big_shape, dtype=np.uint8),
+            "xy": rng.random((32, 8, 2)).astype(np.float32),
+        }
+
+    report("putbig_alone", timed_puts(make_big, n), big_bytes)
+    setup, teardown = all_setup(0)
+    report("putbig_vs_all_nice0", timed_puts(make_big, n, setup, teardown),
+           big_bytes)
+    setup, teardown = all_setup(15)
+    report("putbig_vs_all_nice15", timed_puts(make_big, n, setup, teardown),
+           big_bytes)
+
+
+if __name__ == "__main__":
+    main(n=int(sys.argv[1]) if len(sys.argv) > 1 else 6)

@@ -140,9 +140,11 @@ class BatchLoader:
                 )
                 while True:
                     if self.gate is not None:
-                        self.gate.wait()  # next() does this worker's heavy
-                        # lifting (ring drain + batch assembly): hold it at
-                        # the boundary while a transfer owns the core
+                        # next() does this worker's heavy lifting (ring
+                        # drain + batch assembly): hold it at the boundary
+                        # while a transfer owns the core; stop-aware so
+                        # close() never waits out the gate backstop
+                        self.gate.wait(stop=self._stop)
                     try:
                         out = next(batches)
                     except StopIteration:
@@ -164,7 +166,7 @@ class BatchLoader:
                 batch.append(item)
                 if len(batch) == self.batch_size:
                     if self.gate is not None:
-                        self.gate.wait()
+                        self.gate.wait(stop=self._stop)
                     with self.timer.stage("collate"):
                         out = self.collate_fn(batch)
                     batch = []

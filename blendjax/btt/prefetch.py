@@ -16,14 +16,18 @@ shard and ``make_array_from_process_local_data`` assembles the global array.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import queue
 import threading
+import time
 
 import jax
 import numpy as np
 
 from blendjax.utils.timing import StageTimer
+
+log = logging.getLogger("blendjax")
 
 _SENTINEL = object()
 
@@ -40,29 +44,71 @@ class TransferGate:
     duration of each transfer and feed workers block at their next batch
     boundary instead of stealing the core.
 
+    The gate refcounts in-flight transfers (a ``Condition`` over a
+    counter, not a bare ``Event``), so one gate can safely be shared
+    across several streams: it opens only when EVERY transfer holding it
+    has finished — with an event, the first transfer to finish would
+    reopen the gate while a second was still in flight.
+
     On hosts with cores to spare the gate stays open permanently
-    (``JaxStream(transfer_gate='auto')``) and costs one Event check per
-    batch.
+    (``JaxStream(transfer_gate='auto')``) and costs one check per batch.
+
+    Params
+    ------
+    timeout: float
+        Liveness backstop for :meth:`wait` — a crashed transfer thread
+        must not freeze the feed forever.  When it fires, a warning is
+        logged once (a transfer legitimately longer than this silently
+        losing its gating is exactly the contention the gate exists to
+        prevent, so it must be visible).
     """
 
-    def __init__(self):
-        self._open = threading.Event()
-        self._open.set()
+    def __init__(self, timeout=5.0):
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self.timeout = timeout
+        self._warned = False
 
-    def wait(self, timeout=5.0):
-        """Feed-worker side: block while a transfer is in flight.  The
-        timeout is a liveness backstop — a crashed transfer thread must
-        not freeze the feed forever."""
-        self._open.wait(timeout)
+    def wait(self, timeout=None, stop=None):
+        """Feed-worker side: block while any transfer is in flight.
+
+        Returns when the gate opens, when ``stop`` (an optional
+        ``threading.Event``) is set — so a closing loader never sits out
+        the full backstop — or on backstop expiry."""
+        deadline = time.monotonic() + (
+            self.timeout if timeout is None else timeout
+        )
+        with self._cond:
+            while self._inflight > 0:
+                if stop is not None and stop.is_set():
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    if not self._warned:
+                        self._warned = True
+                        log.warning(
+                            "TransferGate backstop fired after %.1fs: a "
+                            "transfer is outliving the gate timeout "
+                            "(crashed pump, or raise TransferGate("
+                            "timeout=...))", self.timeout,
+                        )
+                    return
+                self._cond.wait(min(0.1, remaining))
 
     @contextlib.contextmanager
     def transfer(self):
-        """Transfer side: close the gate for the duration of the block."""
-        self._open.clear()
+        """Transfer side: hold the gate closed for the duration of the
+        block.  Re-entrant across threads: the gate opens when the LAST
+        concurrent transfer exits."""
+        with self._cond:
+            self._inflight += 1
         try:
             yield
         finally:
-            self._open.set()
+            with self._cond:
+                self._inflight -= 1
+                if self._inflight <= 0:
+                    self._cond.notify_all()
 
 
 def _resolve_gate(transfer_gate, num_workers):
@@ -78,7 +124,12 @@ def _resolve_gate(transfer_gate, num_workers):
         return TransferGate()
     if transfer_gate in (False, None):
         return None
-    return transfer_gate  # caller-supplied gate (shared across streams)
+    if isinstance(transfer_gate, TransferGate):
+        return transfer_gate  # caller-supplied gate (shared across streams)
+    raise ValueError(
+        f"transfer_gate must be 'auto', a bool, None, or a TransferGate; "
+        f"got {transfer_gate!r}"
+    )
 
 
 def put_batch(batch, sharding=None):

@@ -37,9 +37,23 @@ def expert_capacity(n_tokens, n_experts, k, capacity_factor):
     return max(1, math.ceil(k * n_tokens / n_experts * capacity_factor))
 
 
+def _topk_gates(probs, k):
+    """Shared gating prologue — THE one place the routing policy's
+    weights live: top-k probabilities renormalized to sum 1, plus the
+    choice-major assignment-row expert ids (row ``j*n + i`` is token i's
+    j-th choice, so first choices claim capacity slots first).  Both
+    dispatch algorithms and the one-hot view build on this; changing the
+    renormalization here changes all of them together."""
+    n, _ = probs.shape
+    gate_w, gate_idx = jax.lax.top_k(probs, k)  # (n, k)
+    gate_w = gate_w / jnp.clip(gate_w.sum(-1, keepdims=True), 1e-9, None)
+    return gate_idx.T.reshape(k * n), gate_w
+
+
 def topk_assignments(probs, k, capacity):
-    """Top-k routing with capacity-bounded slot assignment — THE routing
-    policy, shared by the apply path and the one-hot matrix view.
+    """Top-k routing with capacity-bounded slot assignment, in the
+    cumsum (scatter-dispatch) form; shared by the scatter apply path and
+    the one-hot matrix view.
 
     Params
     ------
@@ -48,15 +62,12 @@ def topk_assignments(probs, k, capacity):
     capacity: slots per expert (static).
 
     Returns ``(idx, pos, keep, gate_w)``, all choice-major over ``k*n``
-    assignment rows (row ``j*n + i`` is token i's j-th choice, so first
-    choices claim capacity slots first): chosen expert per row, slot
-    index within that expert, whether the row won a slot, and the
-    renormalized top-k gate weights (n, k).
+    assignment rows: chosen expert per row, slot index within that
+    expert, whether the row won a slot, and the renormalized top-k gate
+    weights (n, k).
     """
     n, e = probs.shape
-    gate_w, gate_idx = jax.lax.top_k(probs, k)  # (n, k)
-    gate_w = gate_w / jnp.clip(gate_w.sum(-1, keepdims=True), 1e-9, None)
-    idx = gate_idx.T.reshape(k * n)
+    idx, gate_w = _topk_gates(probs, k)
     oh_i = jax.nn.one_hot(idx, e, dtype=jnp.int32)
     pos = jnp.cumsum(oh_i, axis=0) - oh_i  # prior assignments per expert
     pos = (pos * oh_i).sum(-1)  # (k*n,) slot index within the expert
@@ -92,7 +103,65 @@ def load_balance_loss(probs, gate_idx_top1):
     return e * jnp.sum(f * p)
 
 
-def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25):
+def _dispatch_scatter(xf, idx, pos, keep, n, e, d, capacity, dtype):
+    """Scatter/gather dispatch: build the arena with ``.at[slot].add``.
+
+    GPU-idiomatic; on TPU the feature-space scatter lowers to a serialized
+    dynamic-update-slice chain (VERDICT r3 weak #3) — kept as an option
+    for CPU and for parity testing against the sort path.  Returns
+    ``(expert_in, row_slot)``: arena rows and each assignment row's slot
+    (sentinel ``e*capacity`` when dropped)."""
+    k = idx.shape[0] // n
+    slot = jnp.where(keep, idx * capacity + pos, e * capacity)  # sentinel
+    x_rep = jnp.tile(xf, (k, 1)).astype(dtype)
+    arena = jnp.zeros((e * capacity + 1, d), dtype).at[slot].add(x_rep)
+    return arena[:-1].reshape(e, capacity, d), slot
+
+
+def _dispatch_sort(xf, probs, k, capacity, dtype):
+    """Sort-based dispatch — the TPU-idiomatic path (VERDICT r3 next #3).
+
+    A *stable* argsort of the choice-major assignment rows by expert id
+    groups each expert's assignments contiguously while preserving row
+    order within the group, so the within-expert rank equals the cumsum
+    slot position of :func:`topk_assignments` exactly (parity-tested).
+    The arena is then built with pure GATHERS — slot (q, r) reads sorted
+    position ``start[q] + r`` — and the only scatter anywhere is a
+    (k*n,) int32 inverse-permutation write.  No feature-space scatter,
+    no dynamic-update-slice chains; everything lowers to sorts, gathers
+    and matmuls, which XLA tiles onto the TPU's native units.
+
+    Returns ``(expert_in, row_slot, keep, gate_w)``.
+    """
+    n, e = probs.shape
+    idx, gate_w = _topk_gates(probs, k)  # choice-major assignment rows
+
+    order = jnp.argsort(idx, stable=True)  # (k*n,) sorted-pos -> row
+    sorted_e = idx[order]
+    counts = jnp.bincount(idx, length=e)
+    start = jnp.cumsum(counts) - counts  # exclusive prefix: group starts
+    rank = jnp.arange(k * n, dtype=jnp.int32) - start[sorted_e]
+    keep_sorted = rank < capacity
+    slot_sorted = jnp.where(
+        keep_sorted, sorted_e * capacity + rank, e * capacity
+    )
+    # inverse permutation: each assignment row's slot (int32 scatter only)
+    row_slot = jnp.zeros((k * n,), jnp.int32).at[order].set(slot_sorted)
+    keep = row_slot < e * capacity
+
+    # arena by gather: slot (q, r) <- token of sorted position start[q]+r
+    q = jnp.arange(e * capacity, dtype=jnp.int32) // capacity
+    r = jnp.arange(e * capacity, dtype=jnp.int32) % capacity
+    valid = r < counts[q]
+    src = jnp.where(valid, start[q] + r, 0)
+    token_for_slot = order[src] % n
+    expert_in = jnp.where(
+        valid[:, None], xf[token_for_slot].astype(dtype), 0
+    ).reshape(e, capacity, xf.shape[-1])
+    return expert_in, row_slot, keep, gate_w
+
+
+def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25, dispatch="sort"):
     """Routed MoE layer forward.
 
     ``p`` is the same parameter pytree as the dense mixture
@@ -100,17 +169,17 @@ def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25):
     routing is an apply-time choice, so checkpoints swap freely between
     dense and routed evaluation.
 
-    Dispatch/combine are a SCATTER into the (e*capacity) slot arena and a
-    GATHER back — O(k*n*d) data movement.  The earlier GShard-style
-    one-hot einsum dispatch cost ~1.25*k^2*n^2*d MACs — roughly the
-    expert MLP's own FLOPs again per einsum at bench shapes, and
-    QUADRATIC in tokens where the MLP is linear, so it only got worse
-    with batch/sequence length; that overhead is why routed eval
-    measured slower than it should (VERDICT r2 weak #7).  Slot indices
-    are unique by construction (cumsum positions), so the scatter-add
-    has no collisions; dropped assignments target a sentinel row that is
-    sliced off before the expert MLP and reads back zeros in the
-    gather.
+    ``dispatch`` selects the arena-construction algorithm: ``'sort'``
+    (default; contiguous per-expert slices via a stable sort — the TPU
+    way, see :func:`_dispatch_sort`) or ``'scatter'``
+    (:func:`_dispatch_scatter`).  Both implement the SAME routing policy
+    (top-k, capacity-bounded, first-come-first-served choice-major) and
+    are parity-tested against each other; compute per token is ``k``
+    experts instead of ``n_experts``, dropped tokens ride the residual.
+
+    The combine side is a gather in both cases: each assignment row reads
+    its slot's output (a zero sentinel row when dropped) and the k
+    contributions sum per token, scaled by the renormalized gate weights.
 
     Returns ``(y, aux)`` with ``y`` (b, t, d) and ``aux`` a dict carrying
     ``aux_loss`` (load balance) and ``dispatch_fraction`` (1 - dropped).
@@ -124,12 +193,18 @@ def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25):
     probs = jax.nn.softmax(dense_apply(p["gate"], xf, dtype=jnp.float32), -1)
     capacity = expert_capacity(n, e, k, capacity_factor)
 
-    idx, pos, keep, gate_w = topk_assignments(probs, k, capacity)
-    slot = jnp.where(keep, idx * capacity + pos, e * capacity)  # sentinel
+    if dispatch == "sort":
+        expert_in, row_slot, keep, gate_w = _dispatch_sort(
+            xf, probs, k, capacity, dtype
+        )
+    elif dispatch == "scatter":
+        idx, pos, keep, gate_w = topk_assignments(probs, k, capacity)
+        expert_in, row_slot = _dispatch_scatter(
+            xf, idx, pos, keep, n, e, d, capacity, dtype
+        )
+    else:
+        raise ValueError(f"unknown dispatch {dispatch!r}")
 
-    x_rep = jnp.tile(xf, (k, 1)).astype(dtype)
-    arena = jnp.zeros((e * capacity + 1, d), dtype).at[slot].add(x_rep)
-    expert_in = arena[:-1].reshape(e, capacity, d)
     h = gelu(
         jnp.einsum("ecd,edf->ecf", expert_in, p["w1"].astype(dtype))
         + p["b1"][:, None, :].astype(dtype)
@@ -140,7 +215,7 @@ def moe_apply_topk(p, x, dtype, k=2, capacity_factor=1.25):
         [out.reshape(e * capacity, d), jnp.zeros((1, d), dtype)]
     )
     scale = (gate_w.T.reshape(k * n) * keep).astype(dtype)
-    y = (out_flat[slot] * scale[:, None]).reshape(k, n, d).sum(0)
+    y = (out_flat[row_slot] * scale[:, None]).reshape(k, n, d).sum(0)
     y = y.reshape(b, t, d)
 
     aux = {

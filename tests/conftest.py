@@ -22,6 +22,16 @@ if _resolved is None or os.path.dirname(os.path.abspath(_resolved)) != _bindir:
 # Force, don't setdefault: the ambient env pins JAX_PLATFORMS to the real
 # TPU tunnel, which must never be touched from unit tests.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon sitecustomize registers the tunnel PJRT plugin whenever this
+# var is set, and plugin discovery inside ``import jax`` then dials the
+# relay — with a dead relay every process that imports jax hangs
+# (observed round 4).  Popping it here protects the CHILD processes
+# tests spawn (fake Blender fleet, producers, suite children inherit
+# this env as fresh interpreters); it CANNOT protect the pytest process
+# itself, whose sitecustomize already ran at startup — when the relay
+# is down, run the suite as
+#   env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -x -q
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -97,3 +97,86 @@ def test_put_batch_indivisible_raises():
     mesh = data_mesh()
     with pytest.raises(ValueError, match="not divisible"):
         put_batch({"x": np.zeros((6, 2), np.float32)}, data_sharding(mesh))
+
+
+class TestTransferGate:
+    """ADVICE r3: refcounted shared-gate closure, constructor validation,
+    visible backstop, stop-aware waits."""
+
+    def test_shared_gate_stays_closed_until_last_transfer_exits(self):
+        import threading
+        import time
+
+        from blendjax.btt.prefetch import TransferGate
+
+        gate = TransferGate()
+        release_a = threading.Event()
+        a_entered = threading.Event()
+
+        def long_transfer():
+            with gate.transfer():
+                a_entered.set()
+                release_a.wait(5.0)
+
+        t = threading.Thread(target=long_transfer, daemon=True)
+        t.start()
+        assert a_entered.wait(5.0)
+        # a second transfer enters and exits while the first is in flight:
+        # with the old Event-based gate this REOPENED it prematurely
+        with gate.transfer():
+            pass
+        t0 = time.monotonic()
+        gate.wait(timeout=0.5)
+        waited = time.monotonic() - t0
+        assert waited >= 0.4, (
+            f"gate opened after {waited:.3f}s while a transfer was still "
+            "in flight"
+        )
+        release_a.set()
+        t.join(5.0)
+        t0 = time.monotonic()
+        gate.wait(timeout=2.0)
+        assert time.monotonic() - t0 < 0.5  # open again: returns at once
+
+    def test_wait_observes_stop_event(self):
+        import threading
+        import time
+
+        from blendjax.btt.prefetch import TransferGate
+
+        gate = TransferGate(timeout=30.0)
+        stop = threading.Event()
+        with gate.transfer():
+            stop.set()
+            t0 = time.monotonic()
+            gate.wait(stop=stop)  # must NOT sit out the 30s backstop
+            assert time.monotonic() - t0 < 1.0
+
+    def test_backstop_fires_and_warns_once(self, caplog):
+        import logging
+        import time
+
+        from blendjax.btt.prefetch import TransferGate
+
+        gate = TransferGate(timeout=0.2)
+        with gate.transfer():
+            with caplog.at_level(logging.WARNING, logger="blendjax"):
+                t0 = time.monotonic()
+                gate.wait()
+                assert 0.15 <= time.monotonic() - t0 < 2.0
+                gate.wait()  # second expiry: no duplicate warning
+        warnings = [r for r in caplog.records
+                    if "backstop" in r.getMessage()]
+        assert len(warnings) == 1
+
+    def test_resolve_rejects_junk_values(self):
+        from blendjax.btt.prefetch import TransferGate, _resolve_gate
+
+        with pytest.raises(ValueError, match="transfer_gate"):
+            _resolve_gate("true", num_workers=1)
+        with pytest.raises(ValueError, match="transfer_gate"):
+            _resolve_gate(1, num_workers=1)
+        g = TransferGate()
+        assert _resolve_gate(g, num_workers=1) is g
+        assert _resolve_gate(None, num_workers=1) is None
+        assert _resolve_gate(False, num_workers=1) is None
