@@ -180,6 +180,12 @@ def main():
 
     rl = None
     rl_physics = None
+    # the RL children never touch the accelerator (podracer pins jax to
+    # cpu; the RPC configuration is jax-free) — strip the axon trigger so
+    # a dead tunnel relay can't hang them at import (see suite.py)
+    rl_env = dict(env)
+    rl_env.pop("PALLAS_AXON_POOL_IPS", None)
+    rl_env["JAX_PLATFORMS"] = "cpu"
     remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
     if remaining > 30:
         rl_lines = run_child_collect_json(
@@ -189,7 +195,7 @@ def main():
                 "--instances", str(instances),
                 "--seconds", "8",
             ],
-            env,
+            rl_env,
             min(RL_BUDGET_S, remaining),
         )
         rl = rl_lines[-1] if rl_lines else None
@@ -207,7 +213,7 @@ def main():
                 "--seconds", "5",
                 "--physics-us", "250",
             ],
-            env,
+            rl_env,
             min(45, remaining),
         )
         rl_physics = rl_lines[-1] if rl_lines else None

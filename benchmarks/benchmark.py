@@ -194,15 +194,28 @@ def run(args):
                 measured += 1
                 if args.seconds and time.perf_counter() - t0 >= args.seconds:
                     break
-            # drain: queued steps must finish inside the measured window
+            # drain: queued steps must finish inside the measured window.
+            # The LAST loss is fenced by VALUE FETCH: on backends whose
+            # block_until_ready acks a local buffer instead of completion
+            # (e.g. the experimental axon tunnel — see
+            # benchmarks/timing_calibration.py) the value is the only
+            # proof the chain retired; on real TPU-VM hardware it costs
+            # one extra scalar D2H.
+            last_loss = None
             while inflight:
-                jax.block_until_ready(inflight.popleft())
+                last_loss = inflight.popleft()
+                jax.block_until_ready(last_loss)
+            if last_loss is not None:
+                float(np.asarray(last_loss))
+            # window closes HERE: teardown below (worker joins, socket
+            # closes — up to the recv timeout in the unhappy path) must
+            # not be billed to the measurement
+            elapsed = time.perf_counter() - t0 if t0 is not None else None
         finally:
             it.close()  # unwinds the prefetch thread promptly
             stream.close()
         if t0 is None or measured == 0:
             raise RuntimeError("benchmark produced no measured batches")
-        elapsed = time.perf_counter() - t0
         images = measured * args.batch
 
         stats = stream.timer.summary()
