@@ -218,6 +218,15 @@ def main():
         )
         rl_physics = rl_lines[-1] if rl_lines else None
 
+    out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback)
+    print(json.dumps(out), flush=True)
+
+
+def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
+    """Assemble the driver's single JSON object from whatever phase lines
+    arrived.  Pure (given ``host_fallback``), so the carry-through of
+    stages/windows/canary/fence evidence is unit-testable
+    (tests/test_bench_assembly.py)."""
     extras = {"includes_rendering": False}
 
     def pick(name):
@@ -346,7 +355,7 @@ def main():
         metric, degraded = "cube640x480_images_per_sec_host_stream_only", True
     else:
         sys.stderr.write("no suite phases arrived; host-only fallback\n")
-        ips = host_only_fallback()
+        ips = host_fallback() if host_fallback else 0.0
         metric, degraded = "cube640x480_images_per_sec_host_stream_only", True
 
     out = {
@@ -368,7 +377,7 @@ def main():
         # must not be read as a baseline multiple
         out["vs_baseline_comparable"] = False
     out.update(extras)
-    print(json.dumps(out), flush=True)
+    return out
 
 
 if __name__ == "__main__":

@@ -1,0 +1,113 @@
+"""Unit tests for bench.py's artifact assembly — the carry-through of
+evidence (stages, window stats, canary, fence validation, wire ceiling)
+from suite phase lines into the driver's single JSON object (VERDICT r3
+next #1/#5: the r03 driver line DROPPED the per-phase stage breakdowns)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import assemble  # noqa: E402
+
+
+def _tpu_phases():
+    return {
+        "device_init": {"phase": "device_init", "seconds": 0.1,
+                        "platform": "tpu", "device_kind": "TPU v5 lite"},
+        "fence_validation": {"phase": "fence_validation",
+                             "fence_ok": {"block": False, "fetch": True},
+                             "fence_used": "value_fetch", "platform": "tpu"},
+        "tunnel_canary": {"phase": "tunnel_canary", "platform": "tpu",
+                          "rtt_ms": {"min": 68, "median": 70, "max": 72,
+                                     "n": 3},
+                          "batch_mb": 9.83,
+                          "put_s": {"min": 0.7, "median": 0.8, "max": 0.9,
+                                    "n": 3},
+                          "put_mb_per_s": 13.0},
+        "host_stream": {"phase": "host_stream", "items_per_sec": 1300.0},
+        "stream_to_hbm": {
+            "phase": "stream_to_hbm", "platform": "tpu",
+            "items_per_sec": 10.4, "batches_per_sec": 1.3, "batches": 7,
+            "elapsed_s": 5.4,
+            "items_per_sec_windows": {"min": 9.8, "median": 10.4,
+                                      "max": 11.0, "n": 3},
+            "stages": {"device_put": {"count": 7}},
+            "width": 640, "height": 480, "channels": 4,
+        },
+        "stream_to_train": {
+            "phase": "stream_to_train", "platform": "tpu",
+            "items_per_sec": 10.1, "batches_per_sec": 1.26, "batches": 7,
+            "elapsed_s": 5.6, "step_s": 0.0021, "train_duty_cycle": 0.003,
+            "items_per_sec_windows": {"min": 9.2, "median": 10.1,
+                                      "max": 10.8, "n": 3},
+            "stages": {"feed_wait": {"count": 7}},
+            "step_stats": {"step_s": 0.0021, "dispatch_bound": True},
+            "step_flops_analytic": 3.8e10, "mfu": 0.09,
+            "width": 640, "height": 480, "channels": 4,
+        },
+        "seqformer_train": {
+            "phase": "seqformer_train", "platform": "tpu", "attn": "flash",
+            "items_per_sec": 180.0, "batches_per_sec": 22.5,
+            "tokens_per_sec": 92160.0, "train_duty_cycle": 0.93,
+            "step_s": 0.041, "mfu": 0.33,
+            "items_per_sec_windows": {"min": 170, "median": 180,
+                                      "max": 190, "n": 3},
+            "stages": {"fence": {"count": 3}},
+        },
+        "moe_compare": {
+            "phase": "moe_compare", "platform": "tpu", "experts": 8,
+            "top_k": 2, "moe_dispatch": "sort",
+            "mlp": {"step_s": 0.02}, "dense": {"step_s": 0.095},
+            "topk": {"step_s": 0.04, "dispatch_fraction_measured": 0.98},
+            "topk_over_dense_mixture": 0.42,
+            "consistent_dense_ge_mlp": True,
+        },
+    }
+
+
+def test_tpu_evidence_carries_through():
+    out = assemble(_tpu_phases(), rl={"value": 9900.0, "vs_baseline": 4.95})
+    assert out["metric"] == "cube640x480_images_per_sec_stream_to_train"
+    assert out["value"] == 10.1
+    assert out["train_degraded"] is False
+    # the r03 verdict's missing evidence, now mandatory:
+    assert out["stream_to_train_stages"]["feed_wait"]["count"] == 7
+    assert out["stream_to_train_windows"]["n"] == 3
+    assert out["fence_validation"]["fence_ok"]["block"] is False
+    assert out["tunnel"]["put_mb_per_s"] == 13.0
+    assert out["detector_step_stats"]["dispatch_bound"] is True
+    # wire ceiling: 13.0 MB/s / 1.2288 MB/image = 10.6 img/s
+    assert abs(out["wire_limit_images_per_sec"] - 10.6) < 0.1
+    assert 0.9 < out["pipeline_wire_efficiency"] <= 1.05
+    assert out["wire_bound"] is True  # 10.6 img/s wire < 83 img/s baseline
+    assert out["seqformer"]["attn"] == "flash"
+    assert out["moe_compare"]["topk_over_dense_mixture"] == 0.42
+    assert out["rl_steps_per_sec"] == 9900.0
+
+
+def test_cpu_fallback_wire_keys_not_mixed_across_platforms():
+    """A TPU canary must never be combined with a cpu-fallback child's
+    local throughput (code-review r4 finding)."""
+    phases = _tpu_phases()
+    # device child produced canary then hung; cpu fallback produced train
+    del phases["stream_to_train"], phases["stream_to_hbm"]
+    phases["stream_to_train_cpu"] = {
+        "phase": "stream_to_train_cpu", "platform": "cpu",
+        "items_per_sec": 75.0, "step_s": 0.05, "train_duty_cycle": 1.0,
+        "width": 160, "height": 120, "channels": 4,
+    }
+    out = assemble(phases)
+    assert "wire_limit_images_per_sec" not in out
+    assert "pipeline_wire_efficiency" not in out
+    assert "wire_bound" not in out
+    assert out["metric"] == "cube160x120_images_per_sec_stream_to_train"
+    assert out["train_degraded"] is True
+    assert out["vs_baseline_comparable"] is False
+
+
+def test_no_phases_uses_host_fallback():
+    out = assemble({}, host_fallback=lambda: 123.0)
+    assert out["value"] == 123.0
+    assert out["metric"] == "cube640x480_images_per_sec_host_stream_only"
+    assert out["train_degraded"] is True
