@@ -10,7 +10,11 @@ PYTHON ?= python
 .PHONY: test blender-tests bench dryrun
 
 test:
-	$(PYTHON) -m pytest tests/ -q
+	# env -u: the axon sitecustomize trigger makes `import jax` dial the
+	# TPU tunnel relay; tests are CPU-only and must survive a dead relay
+	# (conftest.py strips it for child processes; the pytest interpreter
+	# itself must start without it)
+	env -u PALLAS_AXON_POOL_IPS $(PYTHON) -m pytest tests/ -q
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.
@@ -29,5 +33,6 @@ bench:
 	$(PYTHON) bench.py
 
 dryrun:
-	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PYTHON) __graft_entry__.py
