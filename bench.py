@@ -265,7 +265,8 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
     if moe:
         extras["moe_compare"] = {
             k: moe[k]
-            for k in ("mlp", "dense", "topk", "topk_over_dense_mixture",
+            for k in ("mlp", "dense", "topk", "topk_alt",
+                      "topk_over_dense_mixture",
                       "consistent_dense_ge_mlp", "experts", "top_k",
                       "moe_dispatch")
             if k in moe

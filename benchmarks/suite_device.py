@@ -710,9 +710,17 @@ def phase_moe_compare(args, budget, tag):
     # evaluated — the r1 design routed top-k replaces), routed top-k.
     # The verdict's bar is topk <= dense at e=8, k=2: routed computes
     # k*capacity_factor expert-passes per token vs the mixture's e.
-    for variant in ("mlp", "dense", "topk"):
-        if not budget.has(30, f"moe_compare[{variant}]"):
-            out[variant] = {"skipped": True}
+    # 'topk_alt' re-times routed top-k with the OTHER dispatch algorithm
+    # (sort vs scatter) when budget allows — the on-chip apples-to-apples
+    # comparison of the r4 dispatch rewrite
+    alt_dispatch = "scatter" if args.moe_dispatch == "sort" else "sort"
+    for variant in ("mlp", "dense", "topk", "topk_alt"):
+        need = 60 if variant == "topk_alt" else 30  # alt is optional: only
+        # with comfortable headroom (its compile is never cache-shared
+        # with the primary dispatch)
+        if not budget.has(need, f"moe_compare[{variant}]"):
+            if variant != "topk_alt":
+                out[variant] = {"skipped": True}
             continue
         vkw = dict(kwargs)
         loss = seqformer.loss_fn
@@ -721,11 +729,12 @@ def phase_moe_compare(args, budget, tag):
             vkw["n_experts"] = args.moe_experts
             loss = functools.partial(seqformer.loss_fn, moe_impl="dense")
             fkw = dict(n_experts=args.moe_experts, moe_impl="dense")
-        elif variant == "topk":
+        elif variant in ("topk", "topk_alt"):
+            dispatch = args.moe_dispatch if variant == "topk" else alt_dispatch
             vkw["n_experts"] = args.moe_experts
             loss = functools.partial(
                 seqformer.loss_fn, moe_impl="topk", moe_k=args.moe_topk,
-                moe_aux_weight=0.01, moe_dispatch=args.moe_dispatch,
+                moe_aux_weight=0.01, moe_dispatch=dispatch,
             )
             fkw = dict(n_experts=args.moe_experts, moe_impl="topk",
                        moe_k=args.moe_topk)
@@ -746,6 +755,9 @@ def phase_moe_compare(args, budget, tag):
              f"{time.perf_counter() - tC:.1f}s, "
              f"step {step_stats['step_s'] * 1e3:.1f}ms")
         entry = {"step_s": step_stats["step_s"], "step_stats": step_stats}
+        if variant in ("topk", "topk_alt"):
+            entry["dispatch"] = dispatch  # set by the elif above for
+            # every topk variant; one source of truth with the loss_fn
         flops_xla = step_flops(train_step, budget, state, warm_dev)
         flops_an = seqformer.train_flops(
             seq_batch, T, args.obs_dim, args.d_model, args.n_heads,

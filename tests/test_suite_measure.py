@@ -1,0 +1,134 @@
+"""Unit tests for the round-4 measurement core in benchmarks/suite_device.py:
+differential-chain step timing and fence-based stream windows (the machinery
+every artifact number now rests on)."""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._common import Budget  # noqa: E402
+from benchmarks.suite_device import (  # noqa: E402
+    _measure_stream,
+    _stats,
+    flops_report,
+    measure_step_time,
+)
+from blendjax.utils.timing import StageTimer  # noqa: E402
+
+
+def _toy_step():
+    @jax.jit
+    def step(state, batch):
+        w = state["w"] + 0.001 * jnp.sum(batch["x"])
+        return {"w": w}, jnp.sum(w)
+
+    return step, {"w": jnp.ones((8, 8))}
+
+
+def test_measure_step_time_returns_positive_median_and_windows():
+    step, state = _toy_step()
+    batch = {"x": jnp.ones((4, 4))}
+    stats, state2 = measure_step_time(step, state, batch, Budget(300),
+                                      windows=2)
+    assert stats["step_s"] > 0
+    assert stats["fence"] == "value_fetch"
+    assert stats["step_ms_windows"]["n"] >= 1
+    assert stats["step_ms_windows"]["min"] <= stats["step_ms_windows"]["max"]
+    assert stats["chain"][1] > stats["chain"][0]
+    # state threaded through the chains, not discarded
+    assert float(jnp.sum(state2["w"])) != float(jnp.sum(state["w"]))
+
+
+class _FakeStream:
+    """Minimal JaxStream stand-in: host batches + a StageTimer."""
+
+    def __init__(self, n_batches, delay_s=0.0):
+        self.timer = StageTimer()
+        self._n = n_batches
+        self._delay = delay_s
+
+    def __iter__(self):
+        def gen():
+            for i in range(self._n):
+                if self._delay:
+                    time.sleep(self._delay)
+                yield {"x": np.full((2, 3), i, np.float32)}
+
+        g = gen()
+
+        class _It:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                return next(g)
+
+            def close(self):
+                g.close()
+
+        return _It()
+
+
+def test_measure_stream_hbm_windows_and_stages():
+    # paced feed so three 0.15s windows cannot exhaust the stream
+    stream = _FakeStream(n_batches=400, delay_s=0.002)
+    res, _ = _measure_stream(
+        stream, window_s=0.15, warmup_batches=2, batch_size=2,
+        fence_every=4, windows=3, budget=Budget(120),
+    )
+    assert res["items_per_sec"] > 0
+    assert res["items_per_sec_windows"]["n"] == 3
+    assert res["fence"] == "value_fetch"
+    # the loop's own stages were recorded for the median window
+    assert "feed_wait" in res["stages"]
+    assert "dispatch" in res["stages"]
+    assert "fence" in res["stages"]
+
+
+def test_measure_stream_train_duty_cycle_and_chain():
+    step, state = _toy_step()
+    stream = _FakeStream(n_batches=400)
+    res, state2 = _measure_stream(
+        stream, window_s=0.15, warmup_batches=2, batch_size=2,
+        train_step=step, state=state, step_s=0.001,
+        fence_every=4, windows=2, budget=Budget(120),
+    )
+    assert res["step_s"] == 0.001
+    assert 0 < res["train_duty_cycle"] <= 1.0
+    assert float(jnp.sum(state2["w"])) != float(jnp.sum(state["w"]))
+
+
+def test_measure_stream_exhaustion_keeps_partial_window():
+    stream = _FakeStream(n_batches=12)
+    res, _ = _measure_stream(
+        stream, window_s=30.0, warmup_batches=2, batch_size=2,
+        fence_every=4, windows=3, budget=Budget(120),
+    )
+    assert res["batches"] == 10  # 12 - 2 warmup, one partial window
+    assert res["items_per_sec_windows"]["n"] == 1
+
+
+def test_flops_report_flags_impossible_mfu_without_clamping():
+    peak = 100e12
+    entry = flops_report({}, step_s=0.001, flops_xla=None,
+                         flops_analytic=1e12, peak=peak)
+    # 1e12 flops in 1 ms = 1e15/s = 10x peak: must flag, must NOT clamp
+    assert entry["mfu"] == pytest.approx(10.0)
+    assert entry["mfu_invalid"] is True
+    ok = flops_report({}, step_s=1.0, flops_xla=2e12, flops_analytic=1e12,
+                      peak=peak)
+    assert ok["mfu"] == pytest.approx(0.01)
+    assert "mfu_invalid" not in ok
+    assert ok["flops_xla_over_analytic"] == pytest.approx(2.0)
+
+
+def test_stats_min_median_max():
+    s = _stats([3.0, 1.0, 2.0])
+    assert (s["min"], s["median"], s["max"], s["n"]) == (1.0, 2.0, 3.0, 3)
