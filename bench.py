@@ -277,6 +277,16 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
         extras["stream_to_hbm_images_per_sec"] = hbm["items_per_sec"]
         extras["stream_to_hbm_windows"] = hbm.get("items_per_sec_windows")
         extras["stream_to_hbm_stages"] = hbm.get("stages")
+    # no _cpu fallback for the gate-off probe: the comparison is only
+    # honest against the SAME child's gate-on number (same platform,
+    # same fleet) — a cross-child pairing would present a tpu-vs-cpu
+    # gap as the measured gate effect
+    gateoff = phases.get("stream_to_hbm_gateoff")
+    if (gateoff and hbm
+            and gateoff.get("platform") == hbm.get("platform")):
+        extras["stream_to_hbm_gateoff_images_per_sec"] = gateoff[
+            "items_per_sec"
+        ]
     if train:
         extras["train_duty_cycle"] = train.get("train_duty_cycle")
         extras["detector_step_ms"] = round(train["step_s"] * 1e3, 3)

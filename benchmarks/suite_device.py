@@ -440,7 +440,7 @@ def phase_cube_stream(args, budget, producers, tag):
     def transform(batch):
         return {"image": batch["image"], "xy": batch["xy"].astype(np.float32)}
 
-    def make_stream():
+    def make_stream(transfer_gate="auto"):
         ds = RemoteIterableDataset(
             addrs, max_items=10**9, timeoutms=60000, queue_size=args.queue
         )
@@ -451,24 +451,47 @@ def phase_cube_stream(args, budget, producers, tag):
             transform=transform,
             prefetch=args.prefetch,
             timer=StageTimer(),
+            transfer_gate=transfer_gate,
         )
 
     # -- phase 1: stream -> HBM ------------------------------------------
     # Windows shrink when the budget is thin (e.g. slow backend init ate
     # most of it): short TPU-fed windows beat a skipped phase.
     hbm_window = min(args.hbm_seconds, max(3.0, budget.remaining() * 0.05))
+    gate_engaged = False
     if budget.has(hbm_window * args.windows + 15, "stream_to_hbm"):
         stream = make_stream()
+        gate_engaged = stream.gate is not None  # what 'auto' resolved to
         try:
             res, _ = _measure_stream(
                 stream, hbm_window, warmup_batches=2,
                 batch_size=args.batch, fence_every=args.fence_every,
                 windows=args.windows, budget=budget,
             )
-            res.update(phase="stream_to_hbm", **tag)
+            res.update(phase="stream_to_hbm",
+                       transfer_gate=gate_engaged, **tag)
             emit(res)
         finally:
             stream.close()
+        # gate-on vs gate-off (VERDICT r3 next #1): one extra window with
+        # the TransferGate disabled, same fleet, so the artifact carries
+        # the measured effect instead of the r3 assumption.  Only
+        # meaningful when 'auto' actually engaged a gate — comparing two
+        # gateless configs would report noise as the gate effect
+        if gate_engaged and budget.has(
+                hbm_window + 12, "stream_to_hbm[gate_off]"):
+            stream = make_stream(transfer_gate=False)
+            try:
+                res, _ = _measure_stream(
+                    stream, hbm_window, warmup_batches=2,
+                    batch_size=args.batch, fence_every=args.fence_every,
+                    windows=1, budget=budget,
+                )
+                res.update(phase="stream_to_hbm_gateoff",
+                           transfer_gate=False, **tag)
+                emit(res)
+            finally:
+                stream.close()
 
     # -- phase 2: stream -> detector train -------------------------------
     train_window = min(args.train_seconds,

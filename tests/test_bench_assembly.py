@@ -67,7 +67,13 @@ def _tpu_phases():
 
 
 def test_tpu_evidence_carries_through():
-    out = assemble(_tpu_phases(), rl={"value": 9900.0, "vs_baseline": 4.95})
+    phases = _tpu_phases()
+    phases["stream_to_hbm_gateoff"] = {
+        "phase": "stream_to_hbm_gateoff", "platform": "tpu",
+        "items_per_sec": 10.2, "transfer_gate": False,
+    }
+    out = assemble(phases, rl={"value": 9900.0, "vs_baseline": 4.95})
+    assert out["stream_to_hbm_gateoff_images_per_sec"] == 10.2
     assert out["metric"] == "cube640x480_images_per_sec_stream_to_train"
     assert out["value"] == 10.1
     assert out["train_degraded"] is False
