@@ -257,6 +257,11 @@ def episode_loss_fn(params, batch, **kwargs):
     the benchmark suite) — that is a disclosed input-precision choice,
     not loss-free: the float32 target comparison then sees quantized
     targets.
+
+    Use with replicated or batch-sharded feeds.  For SEQUENCE-sharded
+    training keep the host-side :func:`make_episode_batch` split: the
+    device-side shift would need a cross-shard neighbor exchange there
+    (see :func:`loss_fn`'s note on the sharded target).
     """
     return loss_fn(params, make_episode_batch(batch["episode"]), **kwargs)
 
