@@ -322,6 +322,8 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
                 "tokens_per_sec",
                 "train_duty_cycle",
                 "attn",
+                "full_attn_step_s",
+                "flash_over_full",
                 "mfu",
                 "mfu_invalid",
                 "step_s",

@@ -651,6 +651,37 @@ def phase_seqformer(args, budget, launch, tag):
              f"{time.perf_counter() - tC:.1f}s, "
              f"step {step_stats['step_s'] * 1e3:.1f}ms")
         step_s = step_stats["step_s"]
+
+        def full_attn_comparison():
+            """VERDICT r3 #4 bar: flash step <= full-attention step at the
+            SAME config, both measured on this device this run.  Runs
+            AFTER the flagship streaming window — an expensive full-attn
+            compile must displace only itself, never the primary
+            measurements."""
+            if attn_name != "flash" or not budget.has(
+                    75, "seqformer full-attn comparison (extra compile)"):
+                return {}
+            try:
+                full_step = make_train_step(seqformer.episode_loss_fn, opt)
+                full_state = TrainState.create(
+                    seqformer.init(jax.random.PRNGKey(0), **kwargs), opt
+                )
+                full_stats, _ = measure_step_time(
+                    full_step, full_state, warm_dev, budget,
+                    windows=max(1, args.windows - 1),
+                )
+                note(f"seqformer[full] step "
+                     f"{full_stats['step_s'] * 1e3:.1f}ms -> flash/full "
+                     f"{round(step_s / full_stats['step_s'], 4)}")
+                return {
+                    "full_attn_step_s": full_stats["step_s"],
+                    "flash_over_full": round(
+                        step_s / full_stats["step_s"], 4
+                    ),
+                }
+            except Exception as e:  # noqa: BLE001 - comparison is optional
+                note(f"full-attn comparison failed: {e}")
+                return {}
         flops_xla = step_flops(train_step, budget, state, warm_dev)
         flops_an = seqformer.train_flops(
             seq_batch, T, args.obs_dim, args.d_model, args.n_heads,
@@ -693,6 +724,7 @@ def phase_seqformer(args, budget, launch, tag):
         finally:
             stream.close()
         res.update(base)
+        res.update(full_attn_comparison())  # after the flagship window
         res["tokens_per_sec"] = round(res["batches_per_sec"] * seq_batch * T, 1)
         res["wire_dtype"] = "float16"
         res["wire_bytes_per_batch"] = seq_batch * args.seq_len * args.obs_dim * 2
