@@ -60,6 +60,13 @@ def test_healthy_backend_runs_device_phases():
     assert phases["host_stream"]["items_per_sec"] > 0
     assert phases["device_init"]["platform"] == "cpu"
     assert "stream_to_hbm" in phases
+    # round-4 evidence phases: the wire canary always runs (fence
+    # validation is TPU-only and must be absent on a cpu backend)
+    assert phases["tunnel_canary"]["put_mb_per_s"] > 0
+    assert "fence_validation" not in phases
+    # streams carry the multi-window distribution + honest fence label
+    assert phases["stream_to_hbm"]["fence"] == "value_fetch"
+    assert phases["stream_to_hbm"]["items_per_sec_windows"]["n"] >= 1
     assert "device_init_timeout" not in phases
 
 
