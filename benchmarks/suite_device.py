@@ -239,31 +239,25 @@ def _measure_stream(stream, window_s, warmup_batches, batch_size,
     the feed threads + this loop's feed_wait/dispatch/fence) maps 1:1
     onto that window.  Returns (result, state).
     """
-    import jax
-    import jax.numpy as jnp
+    from blendjax.utils.fence import fence_chain
 
     timer = stream.timer
-
-    @jax.jit
-    def fence_add(acc, b):
-        return acc + sum(
-            jnp.mean(leaf.astype(jnp.float32)) for leaf in jax.tree.leaves(b)
-        )
-
-    acc = jnp.float32(0.0)
+    chain = fence_chain()
     last_loss = None
 
     def sync():
+        # the train-state chain fences itself through the loss; the HBM
+        # path fences through the folded batch accumulator
         if last_loss is not None:
             _fetch_scalar(last_loss)
         else:
-            _fetch_scalar(acc)
+            chain.sync()
 
     it = iter(stream)
     results = []
     exhausted = False
     try:
-        # warmup: first batches compile fence_add / prime the feed
+        # warmup: first batches compile the fence fold / prime the feed
         for _ in range(max(1, warmup_batches)):
             try:
                 batch = next(it)
@@ -272,7 +266,7 @@ def _measure_stream(stream, window_s, warmup_batches, batch_size,
             if train_step is not None:
                 state, last_loss = train_step(state, batch)
             else:
-                acc = fence_add(acc, batch)
+                chain.fold(batch)
         sync()
 
         for _w in range(windows):
@@ -295,7 +289,7 @@ def _measure_stream(stream, window_s, warmup_batches, batch_size,
                     if train_step is not None:
                         state, last_loss = train_step(state, batch)
                     else:
-                        acc = fence_add(acc, batch)
+                        chain.fold(batch)
                 measured += 1
                 since_fence += 1
                 if since_fence >= fence_every:

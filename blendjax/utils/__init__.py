@@ -1,5 +1,5 @@
-"""Cross-cutting utilities: stage timing / duty-cycle observability and
-train-state checkpointing."""
+"""Cross-cutting utilities: stage timing / duty-cycle observability,
+trustworthy completion fences, and train-state checkpointing."""
 
 from blendjax.utils.checkpoint import (
     load_pytree,
@@ -7,10 +7,14 @@ from blendjax.utils.checkpoint import (
     save_pytree,
     save_train_state,
 )
+from blendjax.utils.fence import fence_chain, fences_valid, value_fence
 from blendjax.utils.timing import StageTimer
 
 __all__ = [
     "StageTimer",
+    "value_fence",
+    "fence_chain",
+    "fences_valid",
     "save_pytree",
     "load_pytree",
     "save_train_state",

@@ -1,0 +1,53 @@
+"""blendjax.utils.fence: value fences, streaming fence chains, and the
+block_until_ready self-check (the round-4 phantom-fence productization)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blendjax.utils import fence_chain, fences_valid, value_fence
+
+
+def test_value_fence_returns_checksum_and_blocks():
+    tree = {"a": jnp.ones((4, 4)), "b": {"c": jnp.full((2,), 2.0)}}
+    v = value_fence(tree)
+    assert v == 3.0  # mean(a)=1 + mean(c)=2
+    assert value_fence({"x": []}) == 0.0
+    assert value_fence([1.0, None]) == 0.0  # non-array leaves ignored
+
+
+def test_fence_chain_folds_and_syncs():
+    chain = fence_chain()
+    f = jax.jit(lambda x: x * 2)
+    total = 0.0
+    for i in range(5):
+        y = f(jnp.full((3,), float(i)))
+        chain.fold(y)
+        total += 2.0 * i
+    assert chain.sync() == total
+    # sync is idempotent and reflects further folds
+    chain.fold(jnp.full((2,), 1.0))
+    assert chain.sync() == total + 1.0
+
+
+def test_fence_chain_fences_dispatched_work():
+    """After sync(), a dispatched computation's effects are observable at
+    host speed (the fetch already waited)."""
+    chain = fence_chain()
+    big = jax.jit(lambda x: jnp.sin(x).sum())(jnp.ones((256, 256)))
+    chain.fold(big)
+    chain.sync()
+    t0 = time.perf_counter()
+    np.asarray(big)  # already done: near-instant
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_fences_valid_on_cpu():
+    """CPU's block_until_ready is a real fence, so an absurd claimed peak
+    flags it and a generous peak clears it."""
+    ok, details = fences_valid(peak_flops_per_sec=1e18, n=256)
+    assert ok, details
+    ok, details = fences_valid(peak_flops_per_sec=1.0, n=256)
+    assert not ok  # any real compute beats a 1 FLOP/s "peak"
