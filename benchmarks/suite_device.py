@@ -330,9 +330,19 @@ def _measure_stream(stream, window_s, warmup_batches, batch_size,
     }
     if step_s is not None:
         out["step_s"] = round(step_s, 6)
-        out["train_duty_cycle"] = round(
-            min(1.0, mid["batches"] * step_s / mid["elapsed_s"]), 4
-        )
+        # UNCLAMPED (VERDICT r4 weak #3): a duty cycle above 1 means the
+        # separately measured step_s and this window's elapsed disagree —
+        # that is evidence of a broken measurement, and laundering it to
+        # 1.0 is the exact pattern that hid r3's phantom MFU.  Flag it,
+        # mirror of mfu_invalid.
+        duty = mid["batches"] * step_s / mid["elapsed_s"]
+        out["train_duty_cycle"] = round(duty, 4)
+        if duty > 1.02:
+            out["duty_cycle_invalid"] = True
+            out["duty_cycle_diagnostic"] = (
+                "batches*step_s exceeds window elapsed — step time or "
+                "window timing is wrong; do not trust this row"
+            )
     return out, state
 
 

@@ -3,12 +3,13 @@ evidence (stages, window stats, canary, fence validation, wire ceiling)
 from suite phase lines into the driver's single JSON object (VERDICT r3
 next #1/#5: the r03 driver line DROPPED the per-phase stage breakdowns)."""
 
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import assemble  # noqa: E402
+from bench import assemble, headline  # noqa: E402
 
 
 def _tpu_phases():
@@ -117,3 +118,92 @@ def test_no_phases_uses_host_fallback():
     assert out["value"] == 123.0
     assert out["metric"] == "cube640x480_images_per_sec_host_stream_only"
     assert out["train_degraded"] is True
+
+
+def test_wire_efficiency_labeled_meaningless_on_cpu():
+    """A full-CPU run computes wire_limit from loopback; the ratio must be
+    labeled as not measuring the pipeline (VERDICT r4 weak #2)."""
+    phases = _tpu_phases()
+    for p in phases.values():
+        if "platform" in p:
+            p["platform"] = "cpu"
+    phases["stream_to_train"]["train_duty_cycle"] = 1.0
+    out = assemble(phases)
+    assert out["wire_efficiency_meaningful"] is False
+    assert "wire_efficiency_caveat" in out
+
+
+def test_wire_efficiency_meaningful_on_wire_bound_tpu():
+    out = assemble(_tpu_phases())
+    # tpu, duty 0.003 (wire binds): the ratio measures the framework
+    assert out["wire_efficiency_meaningful"] is True
+    assert "wire_efficiency_caveat" not in out
+
+
+def test_duty_cycle_invalid_carries_through():
+    phases = _tpu_phases()
+    phases["stream_to_train"]["train_duty_cycle"] = 1.31
+    phases["stream_to_train"]["duty_cycle_invalid"] = True
+    out = assemble(phases)
+    assert out["train_duty_cycle"] == 1.31  # unclamped
+    assert out["duty_cycle_invalid"] is True
+    # an invalid duty must not be presented as a measured "train binds"
+    # diagnosis, nor let the efficiency ratio pass as meaningful
+    assert out["wire_efficiency_meaningful"] is False
+    assert "binding resource unknown" in out["wire_efficiency_caveat"]
+    line = headline(out)
+    assert line["duty_cycle_invalid"] is True
+
+
+def test_headline_flags_invalid_seqformer_duty():
+    phases = _tpu_phases()
+    phases["seqformer_train"]["train_duty_cycle"] = 1.4
+    phases["seqformer_train"]["duty_cycle_invalid"] = True
+    line = headline(assemble(phases))
+    assert line["seq_duty"] == 1.4
+    assert line["seq_duty_invalid"] is True
+
+
+def test_headline_tail_window_self_sufficient():
+    """The compact line printed LAST must fit a 400-byte tail capture and
+    carry the verdict even when the full line is truncated (the r04
+    driver artifact lost its own metric/value — VERDICT r4 weak #1)."""
+    out = assemble(_tpu_phases(), rl={"value": 9900.0, "vs_baseline": 4.95})
+    line = json.dumps(headline(out))
+    assert len(line) + 1 <= 400, f"headline too long: {len(line)}B"
+    # simulate the driver's tail capture over full + headline output
+    stdout = json.dumps(out) + "\n" + line + "\n"
+    tail = stdout[-400:]
+    recovered = json.loads(tail[tail.index("\n") + 1:].strip())
+    assert recovered["headline"] is True
+    assert recovered["metric"] == "cube640x480_images_per_sec_stream_to_train"
+    assert recovered["value"] == 10.1
+    assert recovered["vs_baseline"] == out["vs_baseline"]
+    assert recovered["device"] == "tpu"
+    assert recovered["fence_ok"] is True  # value-fetch fence validated
+    assert recovered["wire_limit"] == out["wire_limit_images_per_sec"]
+    assert recovered["wire_eff"] == out["pipeline_wire_efficiency"]
+    assert recovered["wire_eff_ok"] is True
+    assert recovered["wire_bound"] is True
+    assert recovered["attn"] == "flash"
+    assert recovered["topk_over_dense"] == 0.42
+
+
+def test_headline_fits_tail_in_degraded_modes():
+    """Headline must stay under the tail window in every fallback shape."""
+    cases = [
+        assemble({}, host_fallback=lambda: 123.0),
+        assemble(_tpu_phases()),
+    ]
+    phases = _tpu_phases()
+    del phases["stream_to_train"], phases["stream_to_hbm"]
+    phases["stream_to_train_cpu"] = {
+        "phase": "stream_to_train_cpu", "platform": "cpu",
+        "items_per_sec": 75.0, "step_s": 0.05, "train_duty_cycle": 1.0,
+        "width": 160, "height": 120, "channels": 4,
+    }
+    cases.append(assemble(phases))
+    for out in cases:
+        line = json.dumps(headline(out))
+        assert len(line) + 1 <= 400, f"headline too long: {len(line)}B"
+        assert json.loads(line)["metric"] == out["metric"]
