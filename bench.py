@@ -349,6 +349,10 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
         extras["stream_to_hbm_gateoff_images_per_sec"] = gateoff[
             "items_per_sec"
         ]
+        if "items_per_sec_windows" in gateoff:
+            extras["stream_to_hbm_gateoff_windows"] = gateoff[
+                "items_per_sec_windows"
+            ]
     if train:
         extras["train_duty_cycle"] = train.get("train_duty_cycle")
         if train.get("duty_cycle_invalid"):

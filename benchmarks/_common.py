@@ -71,7 +71,10 @@ def launch_fleet(n, extra, tag, *, transport, raw, ring_nonce, env, nice=10):
     pure contention for the consumer/tunnel-pump whenever the ring has
     space, and backpressure (the blocking ring writer) keeps them fed
     regardless of priority — deprioritizing them shortens transfer tails
-    without starving the stream."""
+    without starving the stream.  The priority drop rides a ``nice -n``
+    command prefix, not ``preexec_fn`` — the parents here run reader/
+    feed threads, and ``preexec_fn`` is documented deadlock-prone in
+    multithreaded processes (ADVICE r4)."""
     from benchmarks.benchmark import free_port
 
     addrs, procs = [], []
@@ -85,9 +88,8 @@ def launch_fleet(n, extra, tag, *, transport, raw, ring_nonce, env, nice=10):
             os.path.join(HERE, "stream_producer.py"),
             "--addr", addr, "--btid", str(i),
         ] + extra + (["--raw"] if raw else [])
-        procs.append(subprocess.Popen(
-            cmd, env=env,
-            preexec_fn=(lambda lvl=nice: os.nice(lvl)) if nice else None,
-        ))
+        if nice:
+            cmd = ["nice", "-n", str(nice)] + cmd
+        procs.append(subprocess.Popen(cmd, env=env))
         addrs.append(addr)
     return Producers(addrs, procs, transport)

@@ -11,15 +11,16 @@ INTERVAL="${PROBE_INTERVAL:-600}"
 TIMEOUT_S="${PROBE_TIMEOUT:-45}"
 while true; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  START=$(date +%s.%N)
-  RESULT=$(timeout "$TIMEOUT_S" python -c "
+  START=$(date +%s)
+  # -k: the dead-relay hang sits in a C extension that can ignore TERM;
+  # without a follow-up KILL the probe loop itself would wedge
+  RESULT=$(timeout -k 5 "$TIMEOUT_S" python -c "
 import jax
 ds = jax.devices()
 print(ds[0].platform, len(ds))
 " 2>/dev/null)
   RC=$?
-  END=$(date +%s.%N)
-  ELAPSED=$(python -c "print(round($END-$START,2))")
+  ELAPSED=$(( $(date +%s) - START ))
   if [ $RC -eq 0 ] && [ -n "$RESULT" ]; then
     PLATFORM=$(echo "$RESULT" | awk '{print $1}')
     echo "{\"ts\": \"$TS\", \"alive\": true, \"platform\": \"$PLATFORM\", \"elapsed_s\": $ELAPSED}" >> "$OUT"

@@ -58,9 +58,9 @@ class TransferGate:
     timeout: float
         Liveness backstop for :meth:`wait` — a crashed transfer thread
         must not freeze the feed forever.  When it fires, a warning is
-        logged once (a transfer legitimately longer than this silently
-        losing its gating is exactly the contention the gate exists to
-        prevent, so it must be visible).
+        logged once per stall episode (re-armed each time the gate next
+        opens, so a later unrelated stall — e.g. after a relay recovery —
+        is visible too; ADVICE r4).
     """
 
     def __init__(self, timeout=5.0):
@@ -72,16 +72,17 @@ class TransferGate:
     def wait(self, timeout=None, stop=None):
         """Feed-worker side: block while any transfer is in flight.
 
-        Returns when the gate opens, when ``stop`` (an optional
-        ``threading.Event``) is set — so a closing loader never sits out
-        the full backstop — or on backstop expiry."""
+        Returns ``True`` when the gate actually opened, ``False`` when
+        the wait ended for another reason — ``stop`` (an optional
+        ``threading.Event``) was set, so a closing loader never sits out
+        the full backstop, or the liveness backstop expired."""
         deadline = time.monotonic() + (
             self.timeout if timeout is None else timeout
         )
         with self._cond:
             while self._inflight > 0:
                 if stop is not None and stop.is_set():
-                    return
+                    return False
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     if not self._warned:
@@ -92,8 +93,9 @@ class TransferGate:
                             "(crashed pump, or raise TransferGate("
                             "timeout=...))", self.timeout,
                         )
-                    return
+                    return False
                 self._cond.wait(min(0.1, remaining))
+        return True
 
     @contextlib.contextmanager
     def transfer(self):
@@ -108,6 +110,9 @@ class TransferGate:
             with self._cond:
                 self._inflight -= 1
                 if self._inflight <= 0:
+                    # gate opens: re-arm the backstop warning so the next
+                    # stall episode logs again
+                    self._warned = False
                     self._cond.notify_all()
 
 

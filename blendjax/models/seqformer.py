@@ -231,6 +231,12 @@ def moe_stats(params, batch, attn_fn=None, compute_dtype=jnp.bfloat16,
         params, batch["obs"], attn_fn, compute_dtype, "topk", moe_k,
         moe_capacity_factor, moe_dispatch,
     )
+    if not auxs:
+        raise ValueError(
+            "moe_stats needs params built with n_experts > 0 — these "
+            "params contain no MoE blocks, so there is no routing to "
+            "measure"
+        )
     n = len(auxs)
     return {
         "dispatch_fraction": sum(a["dispatch_fraction"] for a in auxs) / n,

@@ -169,6 +169,40 @@ class TestTransferGate:
                     if "backstop" in r.getMessage()]
         assert len(warnings) == 1
 
+    def test_backstop_warning_rearms_per_stall_episode(self, caplog):
+        """ADVICE r4: a second, unrelated stall after the gate recovered
+        must warn again — the old latch silenced everything after the
+        first expiry forever."""
+        import logging
+        import time  # noqa: F401
+
+        from blendjax.btt.prefetch import TransferGate
+
+        gate = TransferGate(timeout=0.1)
+        with caplog.at_level(logging.WARNING, logger="blendjax"):
+            with gate.transfer():
+                assert gate.wait() is False  # episode 1: backstop fires
+            # gate opened (transfer exited) -> warning re-armed
+            with gate.transfer():
+                assert gate.wait() is False  # episode 2: fires again
+        warnings = [r for r in caplog.records
+                    if "backstop" in r.getMessage()]
+        assert len(warnings) == 2
+
+    def test_wait_return_distinguishes_open_from_stop_and_expiry(self):
+        import threading
+
+        from blendjax.btt.prefetch import TransferGate
+
+        gate = TransferGate(timeout=0.1)
+        assert gate.wait() is True  # open gate: returns True at once
+        stop = threading.Event()
+        stop.set()
+        with gate.transfer():
+            assert gate.wait(stop=stop) is False     # stop-abort
+            assert gate.wait(timeout=0.05) is False  # backstop expiry
+        assert gate.wait() is True  # reopened
+
     def test_resolve_rejects_junk_values(self):
         from blendjax.btt.prefetch import TransferGate, _resolve_gate
 

@@ -203,3 +203,21 @@ def test_episode_loss_matches_obs_target_split():
         params, {"episode": seq.astype(jnp.float16)}
     )
     np.testing.assert_allclose(float(ep16), float(ref), rtol=5e-3)
+
+
+def test_moe_stats_rejects_expertless_params():
+    """ADVICE r4: params with n_experts=0 have no routing to measure —
+    moe_stats must raise a descriptive error, not ZeroDivisionError."""
+    import pytest
+
+    from blendjax.models import seqformer
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=5, d_model=32, n_heads=4,
+        n_layers=2, max_len=12,
+    )
+    batch = seqformer.make_episode_batch(
+        jax.random.normal(jax.random.PRNGKey(1), (2, 13, 5), jnp.float32)
+    )
+    with pytest.raises(ValueError, match="n_experts"):
+        seqformer.moe_stats(params, batch)
