@@ -140,6 +140,7 @@ def make_seqformer_train_step(
     moe_k=2,
     moe_capacity_factor=1.25,
     moe_aux_weight=0.0,
+    compute_dtype=None,
 ):
     """4-way-parallel training step for the SeqFormer world-model.
 
@@ -189,14 +190,19 @@ def make_seqformer_train_step(
         inner_attn=inner_attn,
     )
     rules = seqformer_rules(model_axis, expert_axis)
-    loss = functools.partial(
-        seqformer.loss_fn,
+    loss_kwargs = dict(
         attn_fn=attn,
         moe_impl=moe_impl,
         moe_k=moe_k,
         moe_capacity_factor=moe_capacity_factor,
         moe_aux_weight=moe_aux_weight,
     )
+    if compute_dtype is not None:
+        # passthrough (default stays the model's bf16): single-device
+        # parity checks pin f32 so sharded-vs-reference agreement is
+        # numerically tight
+        loss_kwargs["compute_dtype"] = compute_dtype
+    loss = functools.partial(seqformer.loss_fn, **loss_kwargs)
     init_sharded, step = make_sharded_train_step(
         loss, optimizer, mesh, rules=rules, data_axis=data_axis
     )
