@@ -1,6 +1,7 @@
 """End-to-end streaming benchmark (port of the reference harness,
 ``benchmarks/benchmark.py``: BATCH=8, 4 producer instances, 4 workers, 512
-items, Cube-scene 640x480 RGBA; first batch discarded as warmup, prints
+items, Cube-scene 640x480 RGB (alpha dropped before the wire); first
+batch discarded as warmup, prints
 sec/image and sec/batch).
 
 Differences, on purpose:
@@ -263,7 +264,7 @@ def parse_args(argv=None):
     ap.add_argument("--queue", type=int, default=10)
     ap.add_argument("--width", type=int, default=640)
     ap.add_argument("--height", type=int, default=480)
-    ap.add_argument("--channels", type=int, default=4)
+    ap.add_argument("--channels", type=int, default=3)
     ap.add_argument("--warmup-batches", type=int, default=8)
     ap.add_argument(
         "--trace",

@@ -3,8 +3,11 @@ speaking the real wire protocol via the real DataPublisher.
 
 Two modes:
 
-- ``frame`` (default): Cube-scene stand-in (640x480 RGBA, reference
-  ``benchmarks/benchmark.py:7-10``) — one image + keypoints per message.
+- ``frame`` (default): Cube-scene stand-in (640x480 RGB, reference
+  ``benchmarks/benchmark.py:7-10``; the reference renders RGBA over a
+  local bus — a TPU-first framework feeding a real network drops the
+  alpha plane, 25% of every byte, before the wire; ``--channels 4``
+  restores RGBA) — one image + keypoints per message.
 - ``episode``: world-model training feed — one (T+1, D) float32
   observation sequence per message, the SeqFormer workload (an episode of
   streamed observations; see ``blendjax/models/seqformer.py``).
@@ -32,7 +35,7 @@ def main():
     ap.add_argument("--mode", choices=["frame", "episode"], default="frame")
     ap.add_argument("--width", type=int, default=640)
     ap.add_argument("--height", type=int, default=480)
-    ap.add_argument("--channels", type=int, default=4)
+    ap.add_argument("--channels", type=int, default=3)
     ap.add_argument("--seq-len", type=int, default=513,
                     help="episode mode: observations per episode (T+1)")
     ap.add_argument("--obs-dim", type=int, default=32)

@@ -199,7 +199,7 @@ def main(argv=None):
     ap.add_argument("--queue", type=int, default=10)
     ap.add_argument("--width", type=int, default=640)
     ap.add_argument("--height", type=int, default=480)
-    ap.add_argument("--channels", type=int, default=4)
+    ap.add_argument("--channels", type=int, default=3)
     ap.add_argument("--prefetch", type=int, default=12)
     ap.add_argument("--max-inflight", type=int, default=8)
     ap.add_argument("--host-seconds", type=float, default=6.0)

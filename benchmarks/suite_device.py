@@ -452,6 +452,63 @@ def phase_tunnel_canary(args, budget, tag):
     emit(out)
 
 
+def phase_put_strategy(args, budget, tag):
+    """Chunked vs whole-batch ``device_put`` under value fences (VERDICT
+    r4 next #6): a streaming feed can stage a batch as one transfer or as
+    chunks that start overlapping compute earlier — but if chunking taxes
+    the wire, the finer granularity is a net loss.  Measure both on THIS
+    device this run and carry winner + loser in the artifact.  TPU only:
+    on a loopback CPU "wire" the comparison measures dispatch overhead,
+    not a transfer strategy."""
+    if tag["platform"] != "tpu" or not budget.has(30, "put_strategy"):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    batch = rng.integers(
+        0, 255, (args.batch, args.height, args.width, args.channels),
+        dtype=np.uint8,
+    )
+    mb = batch.nbytes / 1e6
+    n_chunks = min(4, args.batch)
+    chunks = np.array_split(batch, n_chunks, axis=0)
+
+    fsum = jax.jit(lambda x: jnp.mean(x.astype(jnp.float32)))
+    fsum_many = jax.jit(
+        lambda *xs: sum(jnp.mean(x.astype(jnp.float32)) for x in xs)
+    )
+    _fetch_scalar(fsum(jax.device_put(batch)))  # compile + warm
+    _fetch_scalar(fsum_many(*[jax.device_put(c) for c in chunks]))
+
+    def timed(fn, n=3):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+    whole = timed(lambda: _fetch_scalar(fsum(jax.device_put(batch))))
+    # chunked: dispatch every chunk (transfers may pipeline), one fence
+    chunked = timed(lambda: _fetch_scalar(
+        fsum_many(*[jax.device_put(c) for c in chunks])
+    ))
+    w_med = statistics.median(whole)
+    c_med = statistics.median(chunked)
+    emit({
+        "phase": "put_strategy",
+        "batch_mb": round(mb, 2),
+        "chunks": n_chunks,
+        "whole_s": _stats(whole, 1.0, 3),
+        "chunked_s": _stats(chunked, 1.0, 3),
+        "chunked_over_whole": round(c_med / max(w_med, 1e-9), 3),
+        "winner": "chunked" if c_med < w_med else "whole",
+        "fence": "value_fetch",
+        **tag,
+    })
+
+
 def phase_cube_stream(args, budget, producers, tag):
     """Phases 1+2: cube640x480 stream -> HBM, then -> detector train."""
     import jax
@@ -915,7 +972,7 @@ def main(argv=None):
     ap.add_argument("--queue", type=int, default=10)
     ap.add_argument("--width", type=int, default=640)
     ap.add_argument("--height", type=int, default=480)
-    ap.add_argument("--channels", type=int, default=4)
+    ap.add_argument("--channels", type=int, default=3)
     ap.add_argument("--prefetch", type=int, default=12)
     ap.add_argument("--max-inflight", type=int, default=8,
                     help="unused since the round-4 fence rewrite "
@@ -1033,6 +1090,10 @@ def main(argv=None):
         phase_tunnel_canary(args, budget, tag)
     except Exception as e:  # noqa: BLE001
         note(f"tunnel_canary failed: {type(e).__name__}: {e}")
+    try:
+        phase_put_strategy(args, budget, tag)
+    except Exception as e:  # noqa: BLE001
+        note(f"put_strategy failed: {type(e).__name__}: {e}")
 
     producers = launch(
         args.instances,

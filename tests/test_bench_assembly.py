@@ -64,6 +64,13 @@ def _tpu_phases():
             "topk_over_dense_mixture": 0.42,
             "consistent_dense_ge_mlp": True,
         },
+        "put_strategy": {
+            "phase": "put_strategy", "platform": "tpu", "chunks": 4,
+            "whole_s": {"min": 0.78, "median": 0.8, "max": 0.83, "n": 3},
+            "chunked_s": {"min": 0.8, "median": 0.82, "max": 0.85, "n": 3},
+            "chunked_over_whole": 1.025, "winner": "whole",
+            "batch_mb": 9.83,
+        },
     }
 
 
@@ -75,7 +82,7 @@ def test_tpu_evidence_carries_through():
     }
     out = assemble(phases, rl={"value": 9900.0, "vs_baseline": 4.95})
     assert out["stream_to_hbm_gateoff_images_per_sec"] == 10.2
-    assert out["metric"] == "cube640x480_images_per_sec_stream_to_train"
+    assert out["metric"] == "cube640x480x4_images_per_sec_stream_to_train"
     assert out["value"] == 10.1
     assert out["train_degraded"] is False
     # the r03 verdict's missing evidence, now mandatory:
@@ -91,6 +98,9 @@ def test_tpu_evidence_carries_through():
     assert out["seqformer"]["attn"] == "flash"
     assert out["moe_compare"]["topk_over_dense_mixture"] == 0.42
     assert out["rl_steps_per_sec"] == 9900.0
+    # winner AND loser of the transfer-granularity probe ship together
+    assert out["put_strategy"]["winner"] == "whole"
+    assert out["put_strategy"]["chunked_over_whole"] == 1.025
 
 
 def test_cpu_fallback_wire_keys_not_mixed_across_platforms():
@@ -108,7 +118,7 @@ def test_cpu_fallback_wire_keys_not_mixed_across_platforms():
     assert "wire_limit_images_per_sec" not in out
     assert "pipeline_wire_efficiency" not in out
     assert "wire_bound" not in out
-    assert out["metric"] == "cube160x120_images_per_sec_stream_to_train"
+    assert out["metric"] == "cube160x120x4_images_per_sec_stream_to_train"
     assert out["train_degraded"] is True
     assert out["vs_baseline_comparable"] is False
 
@@ -116,7 +126,7 @@ def test_cpu_fallback_wire_keys_not_mixed_across_platforms():
 def test_no_phases_uses_host_fallback():
     out = assemble({}, host_fallback=lambda: 123.0)
     assert out["value"] == 123.0
-    assert out["metric"] == "cube640x480_images_per_sec_host_stream_only"
+    assert out["metric"] == "cube640x480x3_images_per_sec_host_stream_only"
     assert out["train_degraded"] is True
 
 
@@ -176,7 +186,7 @@ def test_headline_tail_window_self_sufficient():
     tail = stdout[-400:]
     recovered = json.loads(tail[tail.index("\n") + 1:].strip())
     assert recovered["headline"] is True
-    assert recovered["metric"] == "cube640x480_images_per_sec_stream_to_train"
+    assert recovered["metric"] == "cube640x480x4_images_per_sec_stream_to_train"
     assert recovered["value"] == 10.1
     assert recovered["vs_baseline"] == out["vs_baseline"]
     assert recovered["device"] == "tpu"
