@@ -1,0 +1,60 @@
+#!/bin/bash
+# ROUND4_NOTES.md "Validating on a live tunnel", automated.
+#
+# tunnel_probe.sh invokes this the moment a probe sees a non-cpu
+# platform, so a brief tunnel-up window (round 4's relay died ~20 min
+# after coming up) produces the owed TPU artifacts even with nobody at
+# the keyboard.  Order matters: `bench.py` — the driver-captured
+# artifact VERDICT r4 actually owes — runs FIRST so it is the most
+# likely survivor of a short window; fence calibration and the full
+# suite follow while the tunnel lasts.  (bench.py runs its own
+# per-phase fence validation, so the reading is trust-anchored even if
+# the window closes before the standalone calibration.)
+#
+# A lock directory makes it run at most once per successful capture;
+# a failed capture (no device:tpu in the bench artifact) re-arms the
+# lock so the next TUNNEL_UP tries again.  The probe loop pauses its
+# own jax probes while the lock exists — a second client dialing the
+# same tunneled chip would hang AND steal the 1-core host's CPU during
+# fenced timing windows.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="$REPO/benchmarks/results"
+LOCK="$OUT/.r05_live_lock"
+if ! mkdir "$LOCK" 2>/dev/null; then
+  exit 0  # already ran (or running)
+fi
+cd "$REPO"
+export JAX_COMPILATION_CACHE_DIR="$REPO/.jax_cache" \
+       JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+LOG="$OUT/r05_live_runbook_$TS.log"
+echo "live runbook start $TS" > "$LOG"
+
+# 1. the driver's exact run (two JSON lines: artifact + headline) —
+#    the owed reading goes first
+timeout -k 10 600 python bench.py \
+  > "$OUT/r05_bench_$TS.json" 2>> "$LOG"
+BENCH_RC=$?
+echo "bench rc=$BENCH_RC $(date -u +%H:%M:%S)" >> "$LOG"
+
+# 2. standalone fence validity (full, ~2-3 min)
+timeout -k 10 420 python benchmarks/timing_calibration.py \
+  > "$OUT/r05_fence_calibration_$TS.jsonl" 2>> "$LOG"
+echo "calibration rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
+
+# 3. full fenced suite at the runbook's exact flags
+timeout -k 10 700 python benchmarks/suite_device.py --budget 500 \
+  --instances 1 --workers 1 --batch 8 --prefetch 12 --transport shm --raw \
+  > "$OUT/r05_suite_device_$TS.jsonl" 2>> "$LOG"
+echo "suite rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
+
+if [ $BENCH_RC -eq 0 ] && grep -q '"device": "tpu"' "$OUT/r05_bench_$TS.json"; then
+  echo "capture SUCCESS (device:tpu in bench artifact); lock kept" >> "$LOG"
+else
+  # window closed before a TPU-labeled bench artifact landed: re-arm so
+  # the next TUNNEL_UP tries again (partial artifacts stay timestamped)
+  rmdir "$LOCK" 2>/dev/null
+  echo "capture INCOMPLETE; lock re-armed" >> "$LOG"
+fi
+echo "live runbook done $(date -u +%H:%M:%S)" >> "$LOG"

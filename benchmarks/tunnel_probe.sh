@@ -11,6 +11,14 @@ INTERVAL="${PROBE_INTERVAL:-600}"
 TIMEOUT_S="${PROBE_TIMEOUT:-45}"
 while true; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  # pause while the live runbook holds the capture lock: a second jax
+  # client dialing the same tunneled chip would hang, log a false
+  # 'dead' line, and steal the 1-core host's CPU mid-measurement
+  if [ -e "$(dirname "$0")/results/.r05_live_lock" ]; then
+    echo "{\"ts\": \"$TS\", \"event\": \"probe_paused_runbook_active\"}" >> "$OUT"
+    sleep "$INTERVAL"
+    continue
+  fi
   START=$(date +%s)
   # -k: the dead-relay hang sits in a C extension that can ignore TERM;
   # without a follow-up KILL the probe loop itself would wedge
@@ -26,6 +34,9 @@ print(ds[0].platform, len(ds))
     echo "{\"ts\": \"$TS\", \"alive\": true, \"platform\": \"$PLATFORM\", \"elapsed_s\": $ELAPSED}" >> "$OUT"
     if [ "$PLATFORM" != "cpu" ]; then
       echo "{\"ts\": \"$TS\", \"event\": \"TUNNEL_UP\"}" >> "$OUT"
+      # take the owed TPU reading NOW — round 4's window lasted ~20 min.
+      # run_live_runbook.sh self-locks, so repeat alive probes are no-ops
+      nohup "$(dirname "$0")/run_live_runbook.sh" >/dev/null 2>&1 &
     fi
   else
     echo "{\"ts\": \"$TS\", \"alive\": false, \"rc\": $RC, \"elapsed_s\": $ELAPSED}" >> "$OUT"
