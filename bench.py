@@ -148,6 +148,52 @@ def _sweep_shm(child_pid):
             pass
 
 
+def probe_log_summary(path=None):
+    """Summarize the round-5 tunnel liveness probe log for the artifact.
+
+    When the driver run lands on the CPU fallback, the artifact itself
+    carries the documented record of every attempt to reach the TPU
+    (VERDICT r4 next #1: 'if the tunnel never returns, document the
+    attempt') — attempts, successes, and the last status, straight from
+    ``benchmarks/tunnel_probe.sh``'s append-only log."""
+    path = path or os.path.join(
+        HERE, "benchmarks", "results", "r05_tunnel_probes.jsonl"
+    )
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError:
+        return None
+    rows = []
+    for ln in lines:
+        # the probe loop appends concurrently: skip torn/garbage lines
+        # instead of discarding the whole record (or crashing the run)
+        try:
+            r = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(r, dict):
+            rows.append(r)
+    probes = [r for r in rows if "alive" in r]
+    if not probes:
+        return None
+    # a cpu-platform "alive" means the probe child fell back to the CPU
+    # backend — the tunnel was NOT reached (tunnel_probe.sh draws the
+    # same line for TUNNEL_UP)
+    alive = [r for r in probes
+             if r["alive"] and r.get("platform") != "cpu"]
+    out = {
+        "attempts": len(probes),
+        "alive_count": len(alive),
+        "first_ts": probes[0].get("ts"),
+        "last_ts": probes[-1].get("ts"),
+        "last_alive": probes[-1]["alive"],
+    }
+    if alive:
+        out["last_alive_ts"] = alive[-1].get("ts")
+    return out
+
+
 def main():
     sys.path.insert(0, HERE)
     try:
@@ -225,6 +271,10 @@ def main():
         rl_physics = rl_lines[-1] if rl_lines else None
 
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback)
+    if out.get("device") != "tpu":
+        probes = probe_log_summary()
+        if probes:
+            out["tunnel_probe_log"] = probes
     print(json.dumps(out), flush=True)
     # The full line can exceed a tail-capture window (the r04 driver
     # artifact lost its own metric/value to truncation — VERDICT r4 weak

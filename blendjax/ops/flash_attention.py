@@ -114,7 +114,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, :1] + jnp.log(safe))[:, 0]
+        # lse rides as (bh, t, 1) — a (block_q, 1) block keeps the
+        # Mosaic tiling rule (last two block dims divisible by (8, 128)
+        # or equal to the array dims); a flat (1, block_q) lse block is
+        # rejected by the TPU lowering (caught by the tpu-platform
+        # export test, tests/test_tpu_lowering.py)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(safe)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -133,12 +138,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                           block_kv)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        p = jnp.exp(s - lse_ref[0].astype(jnp.float32)[:, None])
+        p = jnp.exp(s - lse_ref[0].astype(jnp.float32))  # (bq,1) bcast
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0].astype(jnp.float32)[:, None]) * scale
+        ds = p * (dp - delta_ref[0].astype(jnp.float32)) * scale
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -167,7 +172,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                           block_kv)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        p = jnp.exp(s - lse_ref[0].astype(jnp.float32)[:, None])
+        p = jnp.exp(s - lse_ref[0].astype(jnp.float32))  # (bq,1) bcast
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -176,7 +181,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0].astype(jnp.float32)[:, None]) * scale
+        ds = p * (dp - delta_ref[0].astype(jnp.float32)) * scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -248,11 +253,13 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            # (bh, t, 1): a (block_q, 1) trailing block satisfies the
+            # Mosaic (8, 128)-or-equal tiling rule; (1, block_q) doesn't
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             _sds((b * h, t, d), q.dtype, qf),
-            _sds((b * h, t), jnp.float32, qf),
+            _sds((b * h, t, 1), jnp.float32, qf),
         ],
         scratch_shapes=_scratch([
             (block_q, d), (block_q, 128), (block_q, 128)
@@ -294,12 +301,15 @@ def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
     num_q = t // block_q
     num_kv = t // block_kv
     dof = _flat(g)
-    # D_i = rowsum(dO * O): the softmax-jacobian correction term
-    delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    # D_i = rowsum(dO * O): the softmax-jacobian correction term; rides
+    # as (bh, t, 1) like lse (Mosaic trailing-block tiling rule)
+    delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(
+        -1, keepdims=True
+    )
 
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec_j = pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0))
-    row_spec_i = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+    row_spec_i = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale_v, causal=causal, block_q=block_q,
@@ -318,7 +328,7 @@ def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
     # the accumulators carry across q steps
     q_spec_inner = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
     kv_spec_mid = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
-    row_spec_inner = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    row_spec_inner = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale_v, causal=causal, block_q=block_q,

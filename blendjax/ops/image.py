@@ -67,7 +67,10 @@ _SUBLANE = 32  # uint8 min tile is (32, 128)
 
 
 def _decode_kernel(x_ref, o_ref, *, linearize):
-    x = x_ref[:].astype(jnp.float32) * (1.0 / 255.0)
+    # Mosaic has no direct uint8->float32 cast (NotImplementedError at
+    # lowering; caught by tests/test_tpu_lowering.py) — widen through
+    # int32 first, which both legs support
+    x = x_ref[:].astype(jnp.int32).astype(jnp.float32) * (1.0 / 255.0)
     if linearize:
         x = jnp.where(x <= 0.04045, x / 12.92, ((x + 0.055) / 1.055) ** 2.4)
     o_ref[:] = x.astype(o_ref.dtype)

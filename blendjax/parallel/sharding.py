@@ -141,6 +141,7 @@ def make_seqformer_train_step(
     moe_capacity_factor=1.25,
     moe_aux_weight=0.0,
     compute_dtype=None,
+    flash_interpret=None,
 ):
     """4-way-parallel training step for the SeqFormer world-model.
 
@@ -169,8 +170,15 @@ def make_seqformer_train_step(
 
         attn_impl = "ulysses"
         # compiled kernel on TPU; the interpreter elsewhere keeps the
-        # option runnable on the CPU mesh used in CI
-        interpret = jax.default_backend() != "tpu"
+        # option runnable on the CPU mesh used in CI.
+        # ``flash_interpret`` overrides (tests/test_tpu_lowering.py
+        # forces the compiled path when EXPORTING for tpu from a CPU
+        # host — the auto rule would silently export the interpreter
+        # lowering and prove nothing about Mosaic)
+        if flash_interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        else:
+            interpret = flash_interpret
 
         def inner_attn(q, k, v, causal=False, scale=None):
             t = q.shape[1]

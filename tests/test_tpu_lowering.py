@@ -1,0 +1,129 @@
+"""Mosaic lowering smoke tests — TPU compilability proven on CPU.
+
+``jax.export`` with ``platforms=["tpu"]`` runs the full Pallas->Mosaic
+lowering pipeline without TPU hardware.  CI executes the kernels only in
+interpret mode, which skips exactly the stage where TPU block-spec rules
+are enforced — this suite closes that gap.  It exists because the gap
+was real: the flash kernel's original flat ``(1, block_q)`` lse output
+block violated the Mosaic trailing-block tiling rule (last two block
+dims divisible by (8, 128) or equal to the array dims) and would have
+failed its first-ever compiled run on the chip (round 5; the artifact
+would have silently degraded to full attention).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "export"), reason="jax.export unavailable"
+)
+
+
+def _export_ok(fn, *args):
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_flash_attention_fwd_bwd_lowers_for_tpu():
+    """The bench configuration: d=128 heads, 128-blocks, causal."""
+    from blendjax.ops.flash_attention import flash_attention
+
+    B, T, H, D = 2, 512, 4, 128
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, True, None, 128, 128, False).sum()
+
+    arg = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+    _export_ok(jax.value_and_grad(loss, argnums=(0, 1, 2)), arg, arg, arg)
+
+
+def test_flash_attention_small_head_dim_lowers_for_tpu():
+    """d=64 < 128 lanes: legal only via the 'equal to the array dim'
+    clause of the tiling rule — the multichip dryrun composes the kernel
+    at even smaller head dims, so this clause must keep lowering."""
+    from blendjax.ops.flash_attention import flash_attention
+
+    B, T, H, D = 1, 256, 2, 64
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, True, None, 128, 128, False)
+
+    arg = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+    _export_ok(fwd, arg, arg, arg)
+
+
+def test_decode_frames_pallas_lowers_for_tpu():
+    from blendjax.ops.image import decode_frames_pallas
+
+    frames = jax.ShapeDtypeStruct((8, 480, 640, 3), jnp.uint8)
+    _export_ok(
+        lambda x: decode_frames_pallas(x, dtype=jnp.bfloat16), frames
+    )
+
+
+def test_seqformer_flash_train_step_lowers_for_tpu():
+    """The exact shape suite_device's seqformer phase runs on the chip:
+    episode_loss_fn + compiled flash kernel + adam update."""
+    import functools
+
+    import optax
+
+    from blendjax.models import seqformer
+    from blendjax.models.train import TrainState, make_train_step
+    from blendjax.ops.flash_attention import make_flash_attention
+
+    T = 128
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=8, d_model=256, n_heads=2,
+        n_layers=1, max_len=T,
+    )
+    opt = optax.adam(1e-4)
+    state = TrainState.create(params, opt)
+    loss = functools.partial(
+        seqformer.episode_loss_fn,
+        attn_fn=make_flash_attention(causal=True, interpret=False),
+    )
+    # donation is dropped under export (no real buffers); keep the step
+    # undonated so the exported signature matches the abstract args
+    step = make_train_step(loss, opt, donate=False)
+    batch = {"episode": jax.ShapeDtypeStruct((2, T + 1, 8), jnp.float16)}
+    state_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+        state,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state_abs, batch)
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_ulysses_flash_sharded_step_lowers_for_tpu():
+    """The dryrun's full composition — 3-axis mesh, Ulysses all-to-all,
+    compiled flash inner attention, routed top-k MoE, adam — exported
+    for the TPU platform.  ``flash_interpret=False`` forces the Mosaic
+    path: the off-TPU auto rule would export the interpreter lowering
+    and prove nothing."""
+    import numpy as np
+    import optax
+
+    from blendjax.models import seqformer
+    from blendjax.parallel import make_mesh, make_seqformer_train_step
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params = seqformer.init(
+        jax.random.PRNGKey(1), obs_dim=6, d_model=32, n_heads=4,
+        n_layers=1, n_experts=4, max_len=32,
+    )
+    init_sf, step, batch_sharding = make_seqformer_train_step(
+        optax.adam(1e-3), mesh, attn_impl="ulysses_flash",
+        moe_impl="topk", moe_k=2, moe_aux_weight=0.01,
+        flash_interpret=False,
+    )
+    state = init_sf(params)
+    batch = jax.device_put(
+        seqformer.make_episode_batch(
+            np.random.default_rng(0).random((4, 33, 6), np.float32)
+        ),
+        batch_sharding,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state, batch)
+    assert len(exp.mlir_module_serialized) > 0
