@@ -151,3 +151,28 @@ def test_flops_report_flags_impossible_mfu_without_clamping():
 def test_stats_min_median_max():
     s = _stats([3.0, 1.0, 2.0])
     assert (s["min"], s["median"], s["max"], s["n"]) == (1.0, 2.0, 3.0, 3)
+
+
+def test_phase_put_strategy_emits_winner_and_loser(capsys):
+    """The transfer-granularity probe ships winner AND loser; gated to
+    tpu-tagged runs (on loopback it measures dispatch, not a strategy).
+    The tag is a label, so the phase body runs fine on the CPU backend."""
+    import argparse
+    import json
+
+    from benchmarks.suite_device import phase_put_strategy
+
+    args = argparse.Namespace(batch=4, height=16, width=16, channels=3)
+    tag = {"platform": "cpu"}
+    phase_put_strategy(args, Budget(120), tag)
+    assert capsys.readouterr().out == ""  # cpu: no emission
+
+    tag = {"platform": "tpu"}
+    phase_put_strategy(args, Budget(120), tag)
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["phase"] == "put_strategy"
+    assert line["winner"] in ("chunked", "whole")
+    assert line["chunks"] == 4
+    assert line["chunked_over_whole"] > 0
+    assert {"min", "median", "max", "n"} <= set(line["whole_s"])
+    assert line["fence"] == "value_fetch"

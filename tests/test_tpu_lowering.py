@@ -127,3 +127,45 @@ def test_ulysses_flash_sharded_step_lowers_for_tpu():
     )
     exp = jax.export.export(step, platforms=["tpu"])(state, batch)
     assert len(exp.mlir_module_serialized) > 0
+
+
+def test_pipeline_1f1b_train_lowers_for_tpu():
+    """Pipeline parallelism is plain XLA (ppermute under shard_map), not
+    Mosaic — but it too has only ever compiled for CPU in CI; export the
+    1F1B training step for the TPU platform like the kernels above."""
+    import numpy as np
+
+    from blendjax.models.layers import dense_apply, dense_init, gelu
+    from blendjax.parallel import (
+        make_mesh,
+        make_pipeline_train,
+        stack_stage_params,
+    )
+
+    mesh = make_mesh({"pipe": 2, "data": 2})
+    d, d_in, d_out = 16, 5, 3
+    rng = np.random.default_rng(0)
+    keys = jax.random.split(jax.random.PRNGKey(0), 2)
+
+    def stage_fn(p, x):
+        return x + gelu(dense_apply(p["fc"], x, dtype=jnp.float32))
+
+    stages = stack_stage_params([{"fc": dense_init(k, d, d)} for k in keys])
+    proj = (
+        {"w": jnp.asarray(rng.standard_normal((d_in, d)), jnp.float32)},
+        {"w": jnp.asarray(rng.standard_normal((d, d_out)), jnp.float32)},
+    )
+    train = make_pipeline_train(
+        stage_fn,
+        lambda pred, tgt: jnp.mean((pred - tgt) ** 2),
+        mesh,
+        schedule="1f1b",
+        in_proj=lambda pp, mb: mb @ pp["w"],
+        out_proj=lambda pp, y: y @ pp["w"],
+    )
+    x = jnp.asarray(rng.standard_normal((4, 2, d_in)), jnp.float32)
+    t = jnp.asarray(rng.standard_normal((4, 2, d_out)), jnp.float32)
+    exp = jax.export.export(jax.jit(train), platforms=["tpu"])(
+        stages, proj, x, t
+    )
+    assert len(exp.mlir_module_serialized) > 0
