@@ -7,7 +7,7 @@
 
 PYTHON ?= python
 
-.PHONY: test blender-tests bench dryrun
+.PHONY: test blender-tests tpu-tests bench dryrun
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -28,6 +28,15 @@ ifdef BLENDER_WRAPPER
 else
 	$(PYTHON) -m pytest tests/ -m blender -q -rs
 endif
+
+# Real-TPU acceptance pack (tests/test_tpu_acceptance.py): fence
+# validity, compiled flash <= full attention, routed top-k <= dense
+# mixture, wire canary — the owed on-chip confirmations as one command.
+# Skips cleanly off-TPU.
+tpu-tests:
+	# BLENDJAX_REAL_TPU=1 disables conftest's CPU forcing so the pack
+	# can reach the hardware
+	BLENDJAX_REAL_TPU=1 $(PYTHON) -m pytest tests/ -m tpu -q -rs
 
 bench:
 	$(PYTHON) bench.py

@@ -21,26 +21,35 @@ if _resolved is None or os.path.dirname(os.path.abspath(_resolved)) != _bindir:
 
 # Force, don't setdefault: the ambient env pins JAX_PLATFORMS to the real
 # TPU tunnel, which must never be touched from unit tests.
-os.environ["JAX_PLATFORMS"] = "cpu"
-# The axon sitecustomize registers the tunnel PJRT plugin whenever this
-# var is set, and plugin discovery inside ``import jax`` then dials the
-# relay — with a dead relay every process that imports jax hangs
-# (observed round 4).  Popping it here protects the CHILD processes
-# tests spawn (fake Blender fleet, producers, suite children inherit
-# this env as fresh interpreters); it CANNOT protect the pytest process
-# itself, whose sitecustomize already ran at startup — when the relay
-# is down, run the suite as
-#   env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -x -q
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# BLENDJAX_REAL_TPU=1 opts OUT of the CPU forcing so the ``tpu``-marker
+# acceptance pack (make tpu-tests) can actually reach the hardware —
+# without it the pack would skip everywhere and read as "hardware
+# merely absent":
+#   BLENDJAX_REAL_TPU=1 python -m pytest tests/ -m tpu -q -rs
+_real_tpu = os.environ.get("BLENDJAX_REAL_TPU", "") == "1"
+if not _real_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # The axon sitecustomize registers the tunnel PJRT plugin whenever
+    # this var is set, and plugin discovery inside ``import jax`` then
+    # dials the relay — with a dead relay every process that imports jax
+    # hangs (observed round 4).  Popping it here protects the CHILD
+    # processes tests spawn (fake Blender fleet, producers, suite
+    # children inherit this env as fresh interpreters); it CANNOT
+    # protect the pytest process itself, whose sitecustomize already ran
+    # at startup — when the relay is down, run the suite as
+    #   env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -x -q
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402  (after env setup, before any test imports it)
 
-jax.config.update("jax_platforms", "cpu")
+if not _real_tpu:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(__file__))  # tests/helpers importable
 

@@ -169,3 +169,70 @@ def test_pipeline_1f1b_train_lowers_for_tpu():
         stages, proj, x, t
     )
     assert len(exp.mlir_module_serialized) > 0
+
+
+def test_detector_decode_train_step_lowers_for_tpu():
+    """The cube stream_to_train program: uint8 frames decoded on device
+    (jnp path) into the detector conv net + adam, RGB wire default."""
+    import optax
+
+    from blendjax.models import detector
+    from blendjax.models.train import TrainState, make_train_step
+    from blendjax.ops.image import decode_frames
+
+    params = detector.init(
+        jax.random.PRNGKey(0), num_keypoints=8, in_channels=3,
+        channels=(8, 16), hidden=32,
+    )
+    opt = optax.adam(1e-3)
+    state = TrainState.create(params, opt)
+
+    def loss_with_decode(params, batch):
+        images = decode_frames(batch["image"], dtype=jnp.bfloat16)
+        return detector.loss_fn(
+            params, {"image": images, "xy": batch["xy"]}
+        )
+
+    step = make_train_step(loss_with_decode, opt, donate=False)
+    batch = {
+        "image": jax.ShapeDtypeStruct((4, 48, 64, 3), jnp.uint8),
+        "xy": jax.ShapeDtypeStruct((4, 8, 2), jnp.float32),
+    }
+    state_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+        state,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state_abs, batch)
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_moe_topk_sort_dispatch_step_lowers_for_tpu():
+    """The moe_compare phase's routed top-k (sort dispatch) program."""
+    import functools
+
+    import optax
+
+    from blendjax.models import seqformer
+    from blendjax.models.train import TrainState, make_train_step
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=8, d_model=64, n_heads=2,
+        n_layers=1, n_experts=4, max_len=32,
+    )
+    opt = optax.adam(1e-4)
+    state = TrainState.create(params, opt)
+    loss = functools.partial(
+        seqformer.loss_fn, moe_impl="topk", moe_k=2,
+        moe_aux_weight=0.01, moe_dispatch="sort",
+    )
+    step = make_train_step(loss, opt, donate=False)
+    batch = {
+        "obs": jax.ShapeDtypeStruct((2, 32, 8), jnp.float32),
+        "target": jax.ShapeDtypeStruct((2, 32, 8), jnp.float32),
+    }
+    state_abs = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+        state,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state_abs, batch)
+    assert len(exp.mlir_module_serialized) > 0
