@@ -231,8 +231,13 @@ def _check_blocks(t, block_q, block_kv):
         )
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
-    """Returns (out (B,T,H,D), flat residuals (qf,kf,vf,of,lse))."""
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
+                    out_dtype=None):
+    """Returns (out (B,T,H,D), flat residuals (qf,kf,vf,of,lse)).
+
+    ``out_dtype`` overrides the output dtype (default: q's) — ring_flash
+    requests f32 so its cross-block combination accumulates unrounded
+    partials (the kernel's internal accumulator is f32 regardless)."""
     b, t, h, d = q.shape
     _check_blocks(t, block_q, block_kv)
     qf, kf, vf = _flat(q), _flat(k), _flat(v)
@@ -258,7 +263,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
             pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
-            _sds((b * h, t, d), q.dtype, qf),
+            _sds((b * h, t, d), out_dtype or q.dtype, qf),
             _sds((b * h, t, 1), jnp.float32, qf),
         ],
         scratch_shapes=_scratch([
@@ -294,58 +299,76 @@ def _fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out, res + (q.shape,)
 
 
+def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
+             block_kv, interpret, out_dtype=None):
+    """dQ for one (Tq, Tk) pair of flat arrays — used over the full
+    sequence by :func:`flash_attention`'s vjp and per ring-block pair by
+    :func:`blendjax.parallel.ring_attention.ring_flash_attention` (which
+    passes ``out_dtype=f32`` so its cross-block accumulation never sums
+    rounded partials)."""
+    bh, tq, d = qf.shape
+    tk = kf.shape[1]
+    num_q, num_kv = tq // block_q, tk // block_kv
+    q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec_j = pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0))
+    row_spec_i = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    return pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_kv=block_kv, num_kv=num_kv,
+        ),
+        grid=(bh, num_q, num_kv),
+        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
+                  row_spec_i],
+        out_specs=q_spec_i,
+        out_shape=_sds((bh, tq, d), out_dtype or qf.dtype, qf),
+        scratch_shapes=_scratch([(block_q, d)]),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+
+def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
+              block_kv, interpret, out_dtype=None):
+    """dK/dV for one (Tq, Tk) pair: kv blocks in the MIDDLE grid dim, q
+    blocks INNERMOST so the accumulators carry across q steps."""
+    bh, tq, d = qf.shape
+    tk = kf.shape[1]
+    num_q, num_kv = tq // block_q, tk // block_kv
+    q_spec_inner = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    kv_spec_mid = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
+    row_spec_inner = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
+    return pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_kv=block_kv, num_q=num_q,
+        ),
+        grid=(bh, num_kv, num_q),
+        in_specs=[q_spec_inner, kv_spec_mid, kv_spec_mid, q_spec_inner,
+                  row_spec_inner, row_spec_inner],
+        out_specs=[kv_spec_mid, kv_spec_mid],
+        out_shape=[
+            _sds((bh, tk, d), out_dtype or kf.dtype, qf),
+            _sds((bh, tk, d), out_dtype or vf.dtype, qf),
+        ],
+        scratch_shapes=_scratch([(block_kv, d), (block_kv, d)]),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+
 def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
     qf, kf, vf, of, lse, qshape = res
     b, t, h, d = qshape
     scale_v = _default_scale(scale, d)
-    num_q = t // block_q
-    num_kv = t // block_kv
     dof = _flat(g)
     # D_i = rowsum(dO * O): the softmax-jacobian correction term; rides
     # as (bh, t, 1) like lse (Mosaic trailing-block tiling rule)
     delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(
         -1, keepdims=True
     )
-
-    q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    kv_spec_j = pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0))
-    row_spec_i = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
-    dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, scale=scale_v, causal=causal, block_q=block_q,
-            block_kv=block_kv, num_kv=num_kv,
-        ),
-        grid=(b * h, num_q, num_kv),
-        in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
-                  row_spec_i],
-        out_specs=q_spec_i,
-        out_shape=_sds((b * h, t, d), qf.dtype, qf),
-        scratch_shapes=_scratch([(block_q, d)]),
-        interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
-
-    # dkv pass: kv blocks in the MIDDLE grid dim, q blocks INNERMOST so
-    # the accumulators carry across q steps
-    q_spec_inner = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
-    kv_spec_mid = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
-    row_spec_inner = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _dkv_kernel, scale=scale_v, causal=causal, block_q=block_q,
-            block_kv=block_kv, num_q=num_q,
-        ),
-        grid=(b * h, num_kv, num_q),
-        in_specs=[q_spec_inner, kv_spec_mid, kv_spec_mid, q_spec_inner,
-                  row_spec_inner, row_spec_inner],
-        out_specs=[kv_spec_mid, kv_spec_mid],
-        out_shape=[
-            _sds((b * h, t, d), kf.dtype, qf),
-            _sds((b * h, t, d), vf.dtype, qf),
-        ],
-        scratch_shapes=_scratch([(block_kv, d), (block_kv, d)]),
-        interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
-
+    dq = _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale_v, block_q,
+                  block_kv, interpret)
+    dk, dv = _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale_v,
+                       block_q, block_kv, interpret)
     return (_unflat(dq, b, h), _unflat(dk, b, h), _unflat(dv, b, h))
 
 

@@ -12,6 +12,7 @@ from blendjax.parallel.ring_attention import (
     full_attention,
     make_ring_attention,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 from blendjax.parallel.sharding import (
@@ -37,6 +38,7 @@ __all__ = [
     "full_attention",
     "make_ring_attention",
     "ring_attention",
+    "ring_flash_attention",
     "ulysses_attention",
     "make_pipeline",
     "make_pipeline_train",

@@ -13,10 +13,19 @@ over the ICI mesh:
   attention over every block using an online (flash-style) softmax, so
   memory stays O(S/n) per device and the permute overlaps with the block
   matmul.  Exact — not an approximation.
+- **Ring + flash** (:func:`ring_flash_attention`): the same ring, with
+  the fused Pallas flash kernel as the per-block-pair attention — no
+  (S/n, S/n) score matrix materializes even within a block, and
+  differentiation is a ring-level custom VJP whose backward rotates K/V
+  *and* their gradient accumulators (fused dQ and dK/dV kernels per
+  visible pair).  The long-context configuration: ring scales past
+  Ulysses' ``heads % n`` constraint while keeping flash's O(block)
+  memory.
 - **Ulysses** (:func:`ulysses_attention`): ``lax.all_to_all`` reshards
   [seq-sharded, all heads] -> [all seq, head-sharded], runs ordinary full
   attention per head group, and reshards back.  Cheaper collectives for
-  moderate sequence lengths; requires ``heads % axis_size == 0``.
+  moderate sequence lengths; requires ``heads % axis_size == 0``
+  (``inner_attn`` slots the flash kernel in per head group).
 
 Both run *inside* ``shard_map`` (the functions take an ``axis_name``);
 :func:`make_ring_attention` wraps one up to act on globally-sharded arrays.
@@ -131,6 +140,177 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _ring_blk(s_loc):
+    """Largest flash tile dividing the local shard (or the shard itself —
+    legal on TPU via the 'equal to the array dim' tiling clause)."""
+    return next((b for b in (128, 64, 32) if s_loc % b == 0), s_loc)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
+                         interpret=False, vary_axes=None):
+    """:func:`ring_attention` with the fused Pallas flash kernel per
+    block pair — O(S/n) memory per device AND no (S/n, S/n) score matrix
+    materialized within a block.
+
+    Call inside ``shard_map`` with local shards (B, S/n, H, D).  Each
+    ring step runs the flash kernel on (my queries x held KV block):
+    blocks strictly before mine attend unmasked, my own block attends
+    causally, later blocks are skipped entirely (their probabilities are
+    exactly zero); partial outputs combine across blocks by logsumexp
+    reweighting — the same online-softmax recurrence the kernel runs
+    internally, lifted to ring granularity.  Differentiation is a
+    custom VJP at the ring level: the backward rotates K/V *and* their
+    gradient accumulators around the ring, running the fused dQ and
+    dK/dV kernels per visible pair, so no pass materializes scores.
+    """
+    out, _ = _ring_flash_fwd(
+        q, k, v, axis_name, causal, scale, interpret, vary_axes
+    )
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
+                    vary_axes):
+    from blendjax.ops.flash_attention import _default_scale, _flash_fwd_impl
+
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale_v = _default_scale(scale, d)
+    blk = _ring_blk(s_loc)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def pair(kb, vb, diag):
+        # out_dtype=f32: the kernel's internal accumulator is f32 —
+        # emitting f32 partials keeps the cross-block combination free
+        # of per-block rounding (bf16 inputs still feed the MXU as bf16)
+        o_b, res = _flash_fwd_impl(
+            q, kb, vb, diag, scale_v, blk, blk, interpret,
+            out_dtype=jnp.float32,
+        )
+        lse_b = res[4].reshape(b, h, s_loc)
+        return o_b, lse_b
+
+    def combine(o, lse, o_b, lse_b):
+        lse_new = jnp.logaddexp(lse, lse_b)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+        return o * w_old + o_b * w_new, lse_new
+
+    def step_compute(o, lse, kb, vb, blk_idx):
+        if not causal:
+            return combine(o, lse, *pair(kb, vb, False))
+        # 0: later block (skip — all-masked), 1: earlier (full), 2: own
+        # (causal diagonal).  The kernel must NOT run on an all-masked
+        # pair: its online softmax would renormalize over masked columns.
+        mode = jnp.where(blk_idx > me, 0, jnp.where(blk_idx < me, 1, 2))
+        return lax.switch(
+            mode,
+            [
+                lambda: (o, lse),
+                lambda: combine(o, lse, *pair(kb, vb, False)),
+                lambda: combine(o, lse, *pair(kb, vb, True)),
+            ],
+        )
+
+    def body(carry, t):
+        o, lse, kb, vb = carry
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        o, lse = step_compute(o, lse, kb, vb, (me + t) % n)
+        return (o, lse, kb, vb), None
+
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    o0, lse0 = (_pvary(x, axes) for x in (o0, lse0))
+    o, lse = step_compute(o0, lse0, k, v, me)  # own block, no rotation
+    (o, lse, _, _), _ = lax.scan(body, (o, lse, k, v), jnp.arange(1, n))
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, interpret, vary_axes,
+                    res, g):
+    from blendjax.ops.flash_attention import (
+        _default_scale,
+        _dkv_pass,
+        _dq_pass,
+        _flat,
+        _unflat,
+    )
+
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale_v = _default_scale(scale, d)
+    blk = _ring_blk(s_loc)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    qf, dof, of = _flat(q), _flat(g), _flat(out)
+    delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(
+        -1, keepdims=True
+    )
+    lse_f = lse.reshape(b * h, s_loc, 1)
+
+    def pair_grads(kbf, vbf, diag):
+        # out_dtype=f32: per-pair gradients leave the kernels unrounded
+        # so the n-block accumulation never sums bf16-rounded partials
+        dq_c = _dq_pass(qf, kbf, vbf, dof, lse_f, delta, diag, scale_v,
+                        blk, blk, interpret, out_dtype=jnp.float32)
+        dk_c, dv_c = _dkv_pass(qf, kbf, vbf, dof, lse_f, delta, diag,
+                               scale_v, blk, blk, interpret,
+                               out_dtype=jnp.float32)
+        return dq_c, dk_c, dv_c
+
+    def step_compute(dq, dk, dv, kbf, vbf, blk_idx):
+        if not causal:
+            dq_c, dk_c, dv_c = pair_grads(kbf, vbf, False)
+            return dq + dq_c, dk + dk_c, dv + dv_c
+
+        def visible(diag):
+            dq_c, dk_c, dv_c = pair_grads(kbf, vbf, diag)
+            return dq + dq_c, dk + dk_c, dv + dv_c
+
+        mode = jnp.where(blk_idx > me, 0, jnp.where(blk_idx < me, 1, 2))
+        return lax.switch(
+            mode,
+            [
+                lambda: (dq, dk, dv),
+                lambda: visible(False),
+                lambda: visible(True),
+            ],
+        )
+
+    def body(carry, t):
+        # held block's dK/dV accumulators travel WITH the block: after
+        # the full cycle of n rotations each lands back on its owner
+        dq, dk, dv, kbf, vbf = carry
+        dq, dk, dv = step_compute(dq, dk, dv, kbf, vbf, (me + t) % n)
+        kbf = lax.ppermute(kbf, axis_name, perm)
+        vbf = lax.ppermute(vbf, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return (dq, dk, dv, kbf, vbf), None
+
+    zeros = jnp.zeros((b * h, s_loc, d), jnp.float32)
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    dq0, dk0, dv0 = (_pvary(jnp.zeros_like(zeros), axes) for _ in range(3))
+    (dq, dk, dv, _, _), _ = lax.scan(
+        body, (dq0, dk0, dv0, _flat(k), _flat(v)), jnp.arange(n)
+    )
+    return (
+        _unflat(dq, b, h).astype(q.dtype),
+        _unflat(dk, b, h).astype(k.dtype),
+        _unflat(dv, b, h).astype(v.dtype),
+    )
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
                       inner_attn=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
@@ -157,27 +337,39 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
 
 def make_ring_attention(
     mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None,
-    head_axis=None, inner_attn=None,
+    head_axis=None, inner_attn=None, flash_interpret=None,
 ):
-    """Wrap :func:`ring_attention` / :func:`ulysses_attention` for global
-    arrays sharded ``P(batch_axis, seq_axis, head_axis, None)`` over
-    ``mesh``.
+    """Wrap :func:`ring_attention` / :func:`ring_flash_attention` /
+    :func:`ulysses_attention` for global arrays sharded
+    ``P(batch_axis, seq_axis, head_axis, None)`` over ``mesh``.
 
     Returns ``attn(q, k, v) -> out`` usable directly under ``jax.jit``.
     ``inner_attn`` (ulysses only) swaps the per-head-group full-sequence
-    attention, e.g. for the fused Pallas flash kernel.
-    Composes with data parallelism (``batch_axis='data'``) and — ring only
-    — with head-sharded tensor parallelism (``head_axis='model'``): each
-    device then ring-rotates K/V for its head block, so sequence and
-    tensor parallelism stack.  Ulysses repurposes the head axis for its
-    all-to-all and cannot also shard it.
+    attention, e.g. for the fused Pallas flash kernel;
+    ``impl='ring_flash'`` instead fuses the kernel into the ring itself
+    (``flash_interpret`` overrides the on/off-TPU interpreter choice).
+    Composes with data parallelism (``batch_axis='data'``) and — ring
+    variants only — with head-sharded tensor parallelism
+    (``head_axis='model'``): each device then ring-rotates K/V for its
+    head block, so sequence and tensor parallelism stack.  Ulysses
+    repurposes the head axis for its all-to-all and cannot also shard it.
     """
     spec = P(batch_axis, seq_axis, head_axis, None)
+    vary = tuple(a for a in (batch_axis, seq_axis, head_axis) if a is not None)
     if impl == "ring":
-        vary = tuple(a for a in (batch_axis, seq_axis, head_axis) if a is not None)
         inner = functools.partial(
             ring_attention, axis_name=seq_axis, causal=causal, vary_axes=vary
         )
+    elif impl == "ring_flash":
+        if flash_interpret is None:
+            flash_interpret = jax.default_backend() != "tpu"
+        ring_interpret = flash_interpret
+
+        def inner(q, k, v, _axis=seq_axis, _vary=vary):
+            # positional call: custom_vjp rejects nondiff args by keyword
+            return ring_flash_attention(
+                q, k, v, _axis, causal, None, ring_interpret, _vary
+            )
     elif impl == "ulysses":
         if head_axis is not None:
             raise ValueError("ulysses uses the head dim for its all-to-all; "
@@ -185,10 +377,27 @@ def make_ring_attention(
         inner = functools.partial(ulysses_attention, axis_name=seq_axis,
                                   causal=causal, inner_attn=inner_attn)
     else:
-        raise ValueError(f"unknown impl {impl!r} (want 'ring' or 'ulysses')")
-    mapped = shard_map(
-        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )
+        raise ValueError(f"unknown impl {impl!r} "
+                         "(want 'ring', 'ring_flash' or 'ulysses')")
+    sm_kwargs = {}
+    if impl == "ring_flash" and flash_interpret:
+        # The Pallas HLO interpreter's grid-carry slicing trips
+        # shard_map's vma typing for non-causal kernel instances (jax
+        # 0.9; the error text itself recommends this flag as the
+        # workaround).  Interpreter-only: the compiled TPU path keeps
+        # full vma checking, and the parity tests check the numbers.
+        sm_kwargs["check_vma"] = False
+    try:
+        mapped = shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            **sm_kwargs,
+        )
+    except TypeError:
+        # older jax (the experimental shard_map fallback import) has no
+        # check_vma kwarg — and no vma typing to work around either
+        mapped = shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
 
     def attn(q, k, v):
         sh = NamedSharding(mesh, spec)

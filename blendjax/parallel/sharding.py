@@ -146,15 +146,17 @@ def make_seqformer_train_step(
     """4-way-parallel training step for the SeqFormer world-model.
 
     Composes every parallelism the framework supports in one jitted step:
-    batch dp-sharded over ``data_axis``, sequence sharded over ``seq_axis``
-    (ring attention — Ulysses with ``attn_impl='ulysses'``, or Ulysses
-    with the fused Pallas flash kernel as the per-head-group inner
-    attention with ``attn_impl='ulysses_flash'``), attention
-    heads + MLP tensor-parallel over ``model_axis``, MoE experts over
-    ``expert_axis`` (see :func:`seqformer_rules`).  ``moe_impl='topk'``
-    switches the expert layer from the dense mixture to routed expert
-    parallelism (top-k gating + capacity, :mod:`blendjax.models.moe`) with
-    an optional load-balance aux loss.
+    batch dp-sharded over ``data_axis``, sequence sharded over
+    ``seq_axis`` — ``attn_impl`` picks the scheme: ``'ring'`` (blockwise
+    ring), ``'ring_flash'`` (the fused Pallas kernel per ring block
+    pair, the long-context configuration), ``'ulysses'`` (all-to-all),
+    or ``'ulysses_flash'`` (all-to-all with the fused kernel as the
+    per-head-group inner attention) — attention heads + MLP
+    tensor-parallel over ``model_axis`` (ring variants only), MoE
+    experts over ``expert_axis`` (see :func:`seqformer_rules`).
+    ``moe_impl='topk'`` switches the expert layer from the dense mixture
+    to routed expert parallelism (top-k gating + capacity,
+    :mod:`blendjax.models.moe`) with an optional load-balance aux loss.
 
     Returns ``(init_sharded, step, batch_sharding)``; device_put batches
     with ``batch_sharding`` (leading dims sharded data x seq).
@@ -167,6 +169,7 @@ def make_seqformer_train_step(
     inner_attn = None
     if attn_impl == "ulysses_flash":
         from blendjax.ops.flash_attention import flash_attention
+        from blendjax.parallel.ring_attention import _ring_blk
 
         attn_impl = "ulysses"
         # compiled kernel on TPU; the interpreter elsewhere keeps the
@@ -181,10 +184,8 @@ def make_seqformer_train_step(
             interpret = flash_interpret
 
         def inner_attn(q, k, v, causal=False, scale=None):
-            t = q.shape[1]
-            blk = next(
-                (b for b in (128, 64, 32) if t % b == 0), t
-            )  # largest tile dividing the gathered sequence
+            # one tile-selection policy for the ulysses and ring paths
+            blk = _ring_blk(q.shape[1])
             return flash_attention(
                 q, k, v, causal, scale, blk, blk, interpret
             )
@@ -194,8 +195,11 @@ def make_seqformer_train_step(
         causal=True,
         impl=attn_impl,
         batch_axis=data_axis,
-        head_axis=model_axis if attn_impl == "ring" else None,
+        head_axis=(model_axis if attn_impl in ("ring", "ring_flash")
+                   else None),
         inner_attn=inner_attn,
+        flash_interpret=(flash_interpret if attn_impl == "ring_flash"
+                         else None),
     )
     rules = seqformer_rules(model_axis, expert_axis)
     loss_kwargs = dict(

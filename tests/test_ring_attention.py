@@ -25,7 +25,7 @@ def qkv():
     return q, k, v
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 @pytest.mark.parametrize("causal", [False, True])
 def test_matches_full_attention(qkv, impl, causal):
     q, k, v = qkv
@@ -36,7 +36,7 @@ def test_matches_full_attention(qkv, impl, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 def test_gradients_match(qkv, impl):
     q, k, v = qkv
     mesh = make_mesh({"seq": 8})
@@ -54,7 +54,7 @@ def test_gradients_match(qkv, impl):
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gr), atol=5e-4)
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
 def test_composes_with_data_parallel(qkv, impl):
     q, k, v = qkv
     mesh = make_mesh({"data": 2, "seq": 4})
@@ -103,3 +103,17 @@ def test_ulysses_with_flash_inner_matches_full():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
+
+
+def test_ring_flash_bf16_accumulates_in_f32(qkv):
+    """bf16 inputs: cross-block partials stay f32 (out_dtype passthrough),
+    so the only error vs an f32 reference is input rounding — per-block
+    bf16 rounding of partial outputs would grow with ring size."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    mesh = make_mesh({"seq": 8})
+    attn = make_ring_attention(mesh, causal=True, impl="ring_flash")
+    got = np.asarray(jax.jit(attn)(q, k, v)).astype(np.float32)
+    want = np.asarray(full_attention(
+        *(x.astype(jnp.float32) for x in (q, k, v)), causal=True
+    ))
+    np.testing.assert_allclose(got, want, atol=2e-2)

@@ -236,3 +236,35 @@ def test_moe_topk_sort_dispatch_step_lowers_for_tpu():
     )
     exp = jax.export.export(step, platforms=["tpu"])(state_abs, batch)
     assert len(exp.mlir_module_serialized) > 0
+
+
+def test_ring_flash_sharded_step_lowers_for_tpu():
+    """ring_flash = the flash kernel fused into ring attention (rotating
+    KV + custom ring-level VJP).  Exported COMPILED (flash_interpret=
+    False) for the TPU platform with full vma checking — the interpreter
+    path in CI uses the check_vma workaround, so this is the only place
+    the compiled lowering's typing is exercised."""
+    import numpy as np
+    import optax
+
+    from blendjax.models import seqformer
+    from blendjax.parallel import make_mesh, make_seqformer_train_step
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params = seqformer.init(
+        jax.random.PRNGKey(1), obs_dim=6, d_model=32, n_heads=4,
+        n_layers=1, max_len=32,
+    )
+    init_sf, step, batch_sharding = make_seqformer_train_step(
+        optax.adam(1e-3), mesh, attn_impl="ring_flash",
+        flash_interpret=False,
+    )
+    state = init_sf(params)
+    batch = jax.device_put(
+        seqformer.make_episode_batch(
+            np.random.default_rng(0).random((4, 33, 6), np.float32)
+        ),
+        batch_sharding,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state, batch)
+    assert len(exp.mlir_module_serialized) > 0
