@@ -295,12 +295,20 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, vary_axes,
         dv = lax.ppermute(dv, axis_name, perm)
         return (dq, dk, dv, kbf, vbf), None
 
-    zeros = jnp.zeros((b * h, s_loc, d), jnp.float32)
     axes = tuple(vary_axes) if vary_axes else (axis_name,)
-    dq0, dk0, dv0 = (_pvary(jnp.zeros_like(zeros), axes) for _ in range(3))
-    (dq, dk, dv, _, _), _ = lax.scan(
-        body, (dq0, dk0, dv0, _flat(k), _flat(v)), jnp.arange(n)
+    dq0, dk0, dv0 = (
+        _pvary(jnp.zeros((b * h, s_loc, d), jnp.float32), axes)
+        for _ in range(3)
     )
+    (dq, dk, dv, kbf, vbf), _ = lax.scan(
+        body, (dq0, dk0, dv0, _flat(k), _flat(v)), jnp.arange(n - 1)
+    )
+    # final block: compute, then rotate ONLY the accumulators home — the
+    # K/V blocks are done, and their last ppermute would be wasted ring
+    # traffic on every training step's critical path
+    dq, dk, dv = step_compute(dq, dk, dv, kbf, vbf, (me + (n - 1)) % n)
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
     return (
         _unflat(dq, b, h).astype(q.dtype),
         _unflat(dk, b, h).astype(k.dtype),
@@ -363,12 +371,12 @@ def make_ring_attention(
     elif impl == "ring_flash":
         if flash_interpret is None:
             flash_interpret = jax.default_backend() != "tpu"
-        ring_interpret = flash_interpret
 
-        def inner(q, k, v, _axis=seq_axis, _vary=vary):
+        def inner(q, k, v, _axis=seq_axis, _vary=vary,
+                  _interp=flash_interpret):
             # positional call: custom_vjp rejects nondiff args by keyword
             return ring_flash_attention(
-                q, k, v, _axis, causal, None, ring_interpret, _vary
+                q, k, v, _axis, causal, None, _interp, _vary
             )
     elif impl == "ulysses":
         if head_axis is not None:
