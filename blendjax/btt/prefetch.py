@@ -146,21 +146,31 @@ def put_batch(batch, sharding=None):
     """
     if sharding is None:
         return jax.device_put(batch)
-    num = getattr(sharding, "num_devices", None) or len(sharding.device_set)
-    leaf = next(iter(jax.tree.leaves(batch)), None)
-    if leaf is not None and hasattr(leaf, "shape") and leaf.shape[0] % num:
-        raise ValueError(
-            f"batch dim {leaf.shape[0]} not divisible by the sharding's "
-            f"{num} devices; pick batch_size as a multiple of the mesh's "
-            f"data axis"
-        )
     if jax.process_count() > 1:
+        # local arrays are SHARDS of the global batch here — validating
+        # them against the global sharding spec would spuriously reject
+        # valid feeds; make_array_from_process_local_data does its own
+        # global-shape reconstruction and validation
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(
                 sharding, np.asarray(x)
             ),
             batch,
         )
+    leaf = next(iter(jax.tree.leaves(batch)), None)
+    if leaf is not None and hasattr(leaf, "shape"):
+        # shard_shape validates per-DIMENSION divisibility against the
+        # sharding's partition spec — the old total-device-count check
+        # wrongly rejected multi-axis shardings (e.g. P('data','seq')
+        # over an 8-device mesh only needs batch % data_axis == 0)
+        try:
+            sharding.shard_shape(tuple(leaf.shape))
+        except Exception as e:
+            raise ValueError(
+                f"batch of shape {tuple(leaf.shape)} not shardable as "
+                f"{sharding}: {e}; pick batch/sequence sizes divisible "
+                "by the mesh axes they shard over"
+            ) from e
     return jax.device_put(batch, sharding)
 
 

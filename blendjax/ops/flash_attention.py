@@ -49,6 +49,14 @@ def _default_scale(scale, d):
     return scale if scale is not None else 1.0 / (d ** 0.5)
 
 
+def flash_block_size(seq_len):
+    """Largest flash tile dividing ``seq_len`` (or ``seq_len`` itself —
+    legal on TPU via the 'equal to the array dim' tiling clause).  THE
+    tile-selection policy, shared by the ring/ulysses parallel paths and
+    user code sizing the kernel for arbitrary sequence lengths."""
+    return next((b for b in (128, 64, 32) if seq_len % b == 0), seq_len)
+
+
 def _block_live(causal, qi, kj, block_q, block_kv):
     """False only for blocks strictly above the causal diagonal — their
     probabilities are exactly zero, so compute is skipped (roughly halves

@@ -141,9 +141,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None)
 
 
 def _ring_blk(s_loc):
-    """Largest flash tile dividing the local shard (or the shard itself —
-    legal on TPU via the 'equal to the array dim' tiling clause)."""
-    return next((b for b in (128, 64, 32) if s_loc % b == 0), s_loc)
+    """Flash tile for a local shard — the shared policy from
+    :func:`blendjax.ops.flash_attention.flash_block_size`."""
+    from blendjax.ops.flash_attention import flash_block_size
+
+    return flash_block_size(s_loc)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -168,8 +168,10 @@ def make_seqformer_train_step(
 
     inner_attn = None
     if attn_impl == "ulysses_flash":
-        from blendjax.ops.flash_attention import flash_attention
-        from blendjax.parallel.ring_attention import _ring_blk
+        from blendjax.ops.flash_attention import (
+            flash_attention,
+            flash_block_size,
+        )
 
         attn_impl = "ulysses"
         # compiled kernel on TPU; the interpreter elsewhere keeps the
@@ -185,7 +187,7 @@ def make_seqformer_train_step(
 
         def inner_attn(q, k, v, causal=False, scale=None):
             # one tile-selection policy for the ulysses and ring paths
-            blk = _ring_blk(q.shape[1])
+            blk = flash_block_size(q.shape[1])
             return flash_attention(
                 q, k, v, causal, scale, blk, blk, interpret
             )

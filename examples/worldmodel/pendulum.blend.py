@@ -1,0 +1,110 @@
+"""Producer script: driven pendulum scene streaming observation EPISODES
+(the SeqFormer world-model workload — no reference counterpart; the
+reference has no sequence models at all, SURVEY.md §5).
+
+Runs inside Blender:
+    blender --python pendulum.blend.py -- -btid 0 -btseed 0 -btsockets DATA=...
+(normally via ``BlenderLauncher(scene='', script='pendulum.blend.py', ...)``).
+
+Each animation episode integrates a damped driven pendulum, moves an
+object along it frame by frame (so the sim state is genuinely what the
+scene shows), and publishes one message per episode:
+``{"obs_seq": (T+1, D) float32, "episode": int}``.  The consumer trains
+next-observation prediction on these sequences.  Fully procedural — no
+checked-in .blend scene.
+"""
+
+import bpy
+import numpy as np
+
+from blendjax import btb
+
+T = 64          # observations per episode (consumer trains on T steps)
+OBS_DIM = 8     # [cos th, sin th, omega, drive, bob xyz, pad]
+
+
+def build_scene():
+    for obj in list(bpy.data.objects):
+        bpy.data.objects.remove(obj, do_unlink=True)
+    bpy.ops.object.empty_add(location=(0, 0, 0))
+    pivot = bpy.context.active_object
+    bpy.ops.mesh.primitive_uv_sphere_add(radius=0.2, location=(0, 0, -2))
+    bob = bpy.context.active_object
+    bob.parent = pivot
+    return pivot, bob
+
+
+class Pendulum:
+    """Damped pendulum with a random sinusoidal drive."""
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.reset()
+
+    def reset(self):
+        self.theta = self.rng.uniform(-2.0, 2.0)
+        self.omega = self.rng.uniform(-1.0, 1.0)
+        self.amp = self.rng.uniform(0.2, 1.5)
+        self.freq = self.rng.uniform(0.5, 2.0)
+        self.t = 0.0
+
+    def step(self, dt=0.05):
+        drive = self.amp * np.sin(self.freq * self.t)
+        alpha = -9.81 / 2.0 * np.sin(self.theta) - 0.15 * self.omega + drive
+        self.omega += alpha * dt
+        self.theta += self.omega * dt
+        self.t += dt
+        return drive
+
+    def obs(self, bob_world):
+        o = np.zeros(OBS_DIM, np.float32)
+        o[0] = np.cos(self.theta)
+        o[1] = np.sin(self.theta)
+        o[2] = self.omega
+        o[3] = self.amp * np.sin(self.freq * self.t)
+        o[4:7] = bob_world
+        return o
+
+
+def main():
+    args, remainder = btb.parse_blendtorch_args()
+    rng = np.random.default_rng(args.btseed)
+
+    pivot, bob = build_scene()
+    pub = btb.DataPublisher(args.btsockets["DATA"], btid=args.btid)
+    sim = Pendulum(rng)
+    buf = []
+    episode = 0
+
+    anim = btb.AnimationController()
+
+    def pre_animation():
+        sim.reset()
+        buf.clear()
+
+    def pre_frame():
+        sim.step()
+        pivot.rotation_euler = (0.0, sim.theta, 0.0)
+
+    def post_frame():
+        bob_world = np.asarray(
+            bob.matrix_world.translation, dtype=np.float32
+        )
+        buf.append(sim.obs(bob_world))
+
+    def post_animation():
+        nonlocal episode
+        if len(buf) >= T + 1:
+            pub.publish(
+                obs_seq=np.stack(buf[: T + 1]), episode=episode
+            )
+        episode += 1
+
+    anim.pre_animation.add(pre_animation)
+    anim.pre_frame.add(pre_frame)
+    anim.post_frame.add(post_frame)
+    anim.post_animation.add(post_animation)
+    anim.play(frame_range=(0, T + 1), num_episodes=-1)
+
+
+main()

@@ -95,7 +95,7 @@ def test_jax_stream_end_to_end():
 
 def test_put_batch_indivisible_raises():
     mesh = data_mesh()
-    with pytest.raises(ValueError, match="not divisible"):
+    with pytest.raises(ValueError, match="not shardable"):
         put_batch({"x": np.zeros((6, 2), np.float32)}, data_sharding(mesh))
 
 
@@ -214,3 +214,33 @@ class TestTransferGate:
         assert _resolve_gate(g, num_workers=1) is g
         assert _resolve_gate(None, num_workers=1) is None
         assert _resolve_gate(False, num_workers=1) is None
+
+
+class TestPutBatchSharding:
+    def test_multi_axis_sharding_accepted(self):
+        """P('data','seq') over an 8-device mesh needs batch % data == 0,
+        not batch % 8 == 0 — the old total-device-count check wrongly
+        rejected every multi-axis sharding (found by the worldmodel
+        example's dp x sp feed)."""
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from blendjax.btt.prefetch import put_batch
+        from blendjax.parallel import make_mesh
+
+        mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+        sh = NamedSharding(mesh, P("data", "seq", None))
+        out = put_batch({"obs": np.zeros((4, 64, 8), np.float32)}, sh)
+        assert out["obs"].sharding == sh
+
+    def test_indivisible_batch_rejected_with_clear_error(self):
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from blendjax.btt.prefetch import put_batch
+        from blendjax.parallel import make_mesh
+
+        mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+        sh = NamedSharding(mesh, P("data", "seq", None))
+        with pytest.raises(ValueError, match="not shardable"):
+            put_batch({"obs": np.zeros((3, 64, 8), np.float32)}, sh)
