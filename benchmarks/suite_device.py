@@ -662,16 +662,20 @@ def _resolve_attn(args, tag, T):
     """'auto' -> the fused Pallas flash kernel on TPU when the length
     allows it (VERDICT r3 next #4: the flagship kernel must actually run
     compiled on the chip), full attention otherwise."""
-    if args.attn == "full" or T % 128 != 0:
+    if args.attn == "full" or T % 32 != 0:
+        # flash tiles are multiples of 32 (flash_block_size); shorter or
+        # ragged lengths stay on full attention
         return "full", None
     if args.attn == "auto" and tag["platform"] != "tpu":
         return "full", None
     from blendjax.ops.flash_attention import make_flash_attention
 
     # compiled kernel on TPU; interpreter elsewhere (CPU fallback child
-    # with --attn flash) so the flag degrades instead of failing
+    # with --attn flash) so the flag degrades instead of failing.
+    # 'auto' tiles size themselves to T, so any 32-multiple works
     return "flash", make_flash_attention(
-        causal=True, interpret=tag["platform"] != "tpu"
+        causal=True, block_q="auto", block_kv="auto",
+        interpret=tag["platform"] != "tpu",
     )
 
 
@@ -1005,7 +1009,8 @@ def main(argv=None):
                     default="auto",
                     help="seqformer attention: 'flash' is the fused "
                          "Pallas kernel (needs seq_len-1 divisible by "
-                         "128); 'auto' picks flash on TPU")
+                         "32; tiles auto-size); 'auto' picks flash on "
+                         "TPU")
     ap.add_argument("--skip-seqformer", action="store_true")
     ap.add_argument("--skip-moe", action="store_true")
     ap.add_argument("--moe-experts", type=int, default=8)

@@ -386,12 +386,29 @@ flash_attention.defvjp(_fwd, _bwd)
 def make_flash_attention(causal=True, block_q=128, block_kv=128,
                          interpret=False):
     """``attn_fn`` closure for :func:`blendjax.models.seqformer.apply` —
-    drop-in for the default ``full_attention`` when T divides the block
-    sizes."""
+    drop-in for the default ``full_attention``.
+
+    ``block_q``/``block_kv`` may be ``'auto'``: the tile is then sized
+    per call via :func:`flash_block_size`, so the closure works at any
+    32-multiple sequence length (or any length up to 128, which fits a
+    single tile) instead of requiring T to divide a fixed block.  Ragged
+    lengths beyond that are rejected — the only "tile" dividing them is
+    T itself, which would materialize the (T, T) score block the kernel
+    exists to avoid (pad upstream instead)."""
 
     def attn(q, k, v):
+        t = q.shape[1]
+        auto = flash_block_size(t)
+        if (block_q == "auto" or block_kv == "auto") and auto == t and t > 128:
+            raise ValueError(
+                f"sequence length {t} has no flash tile (not a multiple "
+                "of 32 and too long for a single tile); pad to a "
+                "32-multiple upstream"
+            )
+        bq = auto if block_q == "auto" else block_q
+        bkv = auto if block_kv == "auto" else block_kv
         return flash_attention(
-            q, k, v, causal, None, block_q, block_kv, interpret
+            q, k, v, causal, None, bq, bkv, interpret
         )
 
     return attn

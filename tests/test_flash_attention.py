@@ -116,3 +116,34 @@ def test_seqformer_attn_fn_integration():
     np.testing.assert_allclose(
         np.asarray(flash), np.asarray(default), atol=2e-4, rtol=2e-4
     )
+
+
+def test_make_flash_attention_auto_tiles_to_sequence():
+    """block='auto' sizes the tile per call via flash_block_size, so the
+    closure works at lengths a fixed 128 block would reject."""
+    import numpy as np
+
+    from blendjax.ops.flash_attention import (
+        flash_block_size,
+        make_flash_attention,
+    )
+    from blendjax.parallel.ring_attention import full_attention
+
+    assert flash_block_size(512) == 128
+    assert flash_block_size(160) == 32
+    assert flash_block_size(20) == 20  # falls back to the length itself
+
+    attn = make_flash_attention(causal=True, block_q="auto",
+                                block_kv="auto", interpret=True)
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 160, 2, 16),
+                          jnp.float32)
+    got = attn(q, q, q)
+    want = full_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+    # ragged beyond a single tile: rejected, not silently O(T^2)
+    bad = jax.random.normal(jax.random.PRNGKey(1), (1, 161, 2, 16),
+                            jnp.float32)
+    with pytest.raises(ValueError, match="pad to a 32-multiple"):
+        attn(bad, bad, bad)

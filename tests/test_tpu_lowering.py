@@ -268,3 +268,16 @@ def test_ring_flash_sharded_step_lowers_for_tpu():
     )
     exp = jax.export.export(step, platforms=["tpu"])(state, batch)
     assert len(exp.mlir_module_serialized) > 0
+
+
+def test_flash_attention_32_tile_lowers_for_tpu():
+    """The bench gate now admits any 32-multiple length; sub-128 tiles
+    (lse blocks (32, 1), scratch (32, 128)) must lower too — a Mosaic
+    rejection specific to small tiles must surface here, not mid-bench
+    on the chip."""
+    from blendjax.ops.flash_attention import make_flash_attention
+
+    attn = make_flash_attention(causal=True, block_q="auto",
+                                block_kv="auto", interpret=False)
+    arg = jax.ShapeDtypeStruct((1, 160, 2, 128), jnp.bfloat16)
+    _export_ok(attn, arg, arg, arg)
