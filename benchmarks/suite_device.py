@@ -868,9 +868,41 @@ def phase_moe_compare(args, budget, tag):
     # k*capacity_factor expert-passes per token vs the mixture's e.
     # 'topk_alt' re-times routed top-k with the OTHER dispatch algorithm
     # (sort vs scatter) when budget allows — the on-chip apples-to-apples
-    # comparison of the r4 dispatch rewrite
+    # comparison of the r4 dispatch rewrite.
+    # Order by evidentiary value: topk and dense make the verdict ratio,
+    # mlp is the sanity row — under budget pressure the ratio must be
+    # what survives (a thin r5 run lost topk to the tail of the phase)
     alt_dispatch = "scatter" if args.moe_dispatch == "sort" else "sort"
-    for variant in ("mlp", "dense", "topk", "topk_alt"):
+    deferred_topk = None
+
+    def run_deferred_topk_extras(deferred):
+        """topk's optional extras, run once dense's timing exists."""
+        if deferred is None:
+            return None
+        train_step, state, entry, fkw = deferred
+        flops_xla = step_flops(train_step, budget, state, warm_dev)
+        flops_an = seqformer.train_flops(
+            seq_batch, T, args.obs_dim, args.d_model, args.n_heads,
+            args.n_layers, **fkw,
+        )
+        flops_report(entry, entry["step_s"], flops_xla, flops_an, peak)
+        if budget.has(45, "moe_stats (extra compile)"):
+            # the MEASURED fraction of (token, choice) assignments that
+            # won a capacity slot — not the analytic k/e bound
+            stats_fn = jax.jit(functools.partial(
+                seqformer.moe_stats, moe_k=args.moe_topk,
+                moe_dispatch=args.moe_dispatch,
+            ))
+            try:
+                st = stats_fn(state.params, warm_dev)
+                entry["dispatch_fraction_measured"] = round(
+                    _fetch_scalar(st["dispatch_fraction"]), 4
+                )
+            except Exception as e:  # noqa: BLE001
+                note(f"moe_stats failed: {e}")
+        return None
+
+    for variant in ("topk", "dense", "mlp", "topk_alt"):
         need = 60 if variant == "topk_alt" else 30  # alt is optional: only
         # with comfortable headroom (its compile is never cache-shared
         # with the primary dispatch)
@@ -914,27 +946,25 @@ def phase_moe_compare(args, budget, tag):
         if variant in ("topk", "topk_alt"):
             entry["dispatch"] = dispatch  # set by the elif above for
             # every topk variant; one source of truth with the loss_fn
+        out[variant] = entry
+        if variant == "topk":
+            # DEFER topk's optional extras (step_flops second compile,
+            # moe_stats) until dense's timing is in hand — each is a
+            # 45s headroom-gated compile that could otherwise starve
+            # the verdict ratio the phase exists to produce
+            deferred_topk = (train_step, state, entry, fkw)
+            continue
         flops_xla = step_flops(train_step, budget, state, warm_dev)
         flops_an = seqformer.train_flops(
             seq_batch, T, args.obs_dim, args.d_model, args.n_heads,
             args.n_layers, **fkw,
         )
         flops_report(entry, step_stats["step_s"], flops_xla, flops_an, peak)
-        if variant == "topk" and budget.has(45, "moe_stats (extra compile)"):
-            # the MEASURED fraction of (token, choice) assignments that
-            # won a capacity slot — not the analytic k/e bound
-            stats_fn = jax.jit(functools.partial(
-                seqformer.moe_stats, moe_k=args.moe_topk,
-                moe_dispatch=args.moe_dispatch,
-            ))
-            try:
-                st = stats_fn(state.params, warm_dev)
-                entry["dispatch_fraction_measured"] = round(
-                    _fetch_scalar(st["dispatch_fraction"]), 4
-                )
-            except Exception as e:  # noqa: BLE001
-                note(f"moe_stats failed: {e}")
-        out[variant] = entry
+        if variant == "dense":
+            deferred_topk = run_deferred_topk_extras(deferred_topk)
+    # dense skipped/failed: topk's deferred extras still belong in the
+    # artifact (runs at most once — run_deferred consumed it otherwise)
+    deferred_topk = run_deferred_topk_extras(deferred_topk)
     # NOTE key rename vs rounds <=2: 'dense' was previously the plain MLP;
     # it now means the every-expert soft mixture, and the ratio key says so
     if "step_s" in out.get("dense", {}) and "step_s" in out.get("topk", {}):
