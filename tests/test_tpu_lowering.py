@@ -206,8 +206,11 @@ def test_detector_decode_train_step_lowers_for_tpu():
     assert len(exp.mlir_module_serialized) > 0
 
 
-def test_moe_topk_sort_dispatch_step_lowers_for_tpu():
-    """The moe_compare phase's routed top-k (sort dispatch) program."""
+@pytest.mark.parametrize("dispatch", ["sort", "scatter"])
+def test_moe_topk_dispatch_step_lowers_for_tpu(dispatch):
+    """The moe_compare phase's routed top-k program, both dispatch
+    algorithms — the scatter arena exercises a different Mosaic path
+    than the sort/gather default (the topk_alt row on TPU)."""
     import functools
 
     import optax
@@ -223,7 +226,7 @@ def test_moe_topk_sort_dispatch_step_lowers_for_tpu():
     state = TrainState.create(params, opt)
     loss = functools.partial(
         seqformer.loss_fn, moe_impl="topk", moe_k=2,
-        moe_aux_weight=0.01, moe_dispatch="sort",
+        moe_aux_weight=0.01, moe_dispatch=dispatch,
     )
     step = make_train_step(loss, opt, donate=False)
     batch = {
