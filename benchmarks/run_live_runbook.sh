@@ -49,6 +49,13 @@ timeout -k 10 700 python benchmarks/suite_device.py --budget 500 \
   > "$OUT/r05_suite_device_$TS.jsonl" 2>> "$LOG"
 echo "suite rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
 
+# 4. best-effort: the judge-runnable acceptance pack (fence validity,
+#    compiled flash <= full, topk <= dense, wire canary) — after the
+#    owed artifacts, only if the tunnel is still up
+timeout -k 10 900 env BLENDJAX_REAL_TPU=1 python -m pytest tests/ -m tpu \
+  -q -rs > "$OUT/r05_tpu_acceptance_$TS.txt" 2>&1
+echo "tpu-tests rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
+
 if [ $BENCH_RC -eq 0 ] && grep -q '"device": "tpu"' "$OUT/r05_bench_$TS.json"; then
   echo "capture SUCCESS (device:tpu in bench artifact); lock kept" >> "$LOG"
 else
