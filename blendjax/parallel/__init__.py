@@ -14,6 +14,7 @@ from blendjax.parallel.ring_attention import (
     ring_attention,
     ring_flash_attention,
     ulysses_attention,
+    zigzag_flash_attention,
 )
 from blendjax.parallel.sharding import (
     detector_rules,
@@ -40,6 +41,7 @@ __all__ = [
     "ring_attention",
     "ring_flash_attention",
     "ulysses_attention",
+    "zigzag_flash_attention",
     "make_pipeline",
     "make_pipeline_train",
     "microbatch",

@@ -4,8 +4,8 @@ The reference has no sequence models at all (SURVEY.md §5 "long-context:
 absent"), but blendjax treats long-context as first-class: episodes
 streamed out of Blender are *sequences* (frames, observations, actions),
 and temporal models over long episodes need the sequence dimension sharded
-across chips.  Two standard TPU-native schemes, both pure-JAX collectives
-over the ICI mesh:
+across chips.  Four TPU-native schemes, all pure-JAX collectives (plus
+the Pallas kernel) over the ICI mesh:
 
 - **Ring attention** (:func:`ring_attention`): every device holds one
   contiguous sequence shard of Q, K and V.  K/V blocks rotate around the
@@ -21,13 +21,19 @@ over the ICI mesh:
   visible pair).  The long-context configuration: ring scales past
   Ulysses' ``heads % n`` constraint while keeping flash's O(block)
   memory.
+- **Zigzag ring + flash** (:func:`zigzag_flash_attention`): ring+flash
+  with the load-balanced chunk layout for CAUSAL attention — plain
+  causal ring leaves early devices idle (device 0's queries see one
+  block, device n-1's see all n); pairing chunks from both sequence
+  ends (shard d holds chunks d and 2n-1-d) gives every device identical
+  visible work per rotation.
 - **Ulysses** (:func:`ulysses_attention`): ``lax.all_to_all`` reshards
   [seq-sharded, all heads] -> [all seq, head-sharded], runs ordinary full
   attention per head group, and reshards back.  Cheaper collectives for
   moderate sequence lengths; requires ``heads % axis_size == 0``
   (``inner_attn`` slots the flash kernel in per head group).
 
-Both run *inside* ``shard_map`` (the functions take an ``axis_name``);
+All run *inside* ``shard_map`` (the functions take an ``axis_name``);
 :func:`make_ring_attention` wraps one up to act on globally-sharded arrays.
 Causal masking uses global positions reconstructed from
 ``lax.axis_index``, so results match single-device attention bit-for-bit
@@ -148,6 +154,17 @@ def _ring_blk(s_loc):
     return flash_block_size(s_loc)
 
 
+def _lse_combine(o, lse, o_b, lse_b):
+    """Merge a new normalized partial (o_b, lse_b) into a running
+    (o, lse) by logsumexp reweighting — the online-softmax recurrence at
+    ring granularity, shared by the ring_flash and zigzag variants.
+    ``o``: (B, S, H, D) f32; ``lse``: (B, H, S) f32."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w_new = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w_old + o_b * w_new, lse_new
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
                          interpret=False, vary_axes=None):
@@ -194,11 +211,7 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
         lse_b = res[4].reshape(b, h, s_loc)
         return o_b, lse_b
 
-    def combine(o, lse, o_b, lse_b):
-        lse_new = jnp.logaddexp(lse, lse_b)
-        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
-        w_new = jnp.exp(lse_b - lse_new).transpose(0, 2, 1)[..., None]
-        return o * w_old + o_b * w_new, lse_new
+    combine = _lse_combine
 
     def step_compute(o, lse, kb, vb, blk_idx):
         if not causal:
@@ -321,6 +334,230 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, vary_axes,
 ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
+def _zigzag_perm(seq_len, n):
+    """Global index permutation laying the sequence out so contiguous
+    shard ``d`` holds chunks ``(d, 2n-1-d)`` of ``2n`` contiguous
+    chunks.  Numpy (static): the permutation is data-independent."""
+    import numpy as _np
+
+    c = 2 * n
+    if seq_len % c:
+        raise ValueError(
+            f"zigzag layout needs sequence length {seq_len} divisible "
+            f"by 2*n_devices = {c}"
+        )
+    chunk = seq_len // c
+    order = []
+    for dd in range(n):
+        order += [dd, c - 1 - dd]
+    return _np.concatenate(
+        [_np.arange(o * chunk, (o + 1) * chunk) for o in order]
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def zigzag_flash_attention(q, k, v, axis_name, scale=None,
+                           interpret=False, vary_axes=None):
+    """Load-balanced CAUSAL ring attention with the fused flash kernel.
+
+    Plain causal ring attention is imbalanced: device 0's queries see one
+    block, device n-1's see all n — the ring's total compute slots are
+    ~2x the visible work, and every step waits for the busiest device.
+    The zigzag layout pairs chunks from both ends of the sequence
+    (shard ``d`` holds chunks ``d`` and ``2n-1-d`` of ``2n``), making
+    every device's total visible work identical (``2n+1`` chunk pairs).
+
+    Call inside ``shard_map`` with local shards ALREADY in zigzag layout
+    (:func:`make_ring_attention` with ``impl='zigzag_flash'`` applies
+    the global permutation and its inverse around the shard_map).  Each
+    ring step runs up to 4 flash-kernel pair calls (2 query half-chunks
+    x 2 held KV half-chunks), each unmasked / causal-diagonal / skipped
+    by chunk-index comparison; the backward rotates KV *and* per-half
+    dK/dV accumulators like :func:`ring_flash_attention`.  Causal only —
+    non-causal rings have no imbalance to fix.
+    """
+    out, _ = _zz_fwd(q, k, v, axis_name, scale, interpret, vary_axes)
+    return out
+
+
+def _zz_fwd(q, k, v, axis_name, scale, interpret, vary_axes):
+    from blendjax.ops.flash_attention import _default_scale, _flash_fwd_impl
+
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    half = s_loc // 2
+    c = 2 * n
+    scale_v = _default_scale(scale, d)
+    blk = _ring_blk(half)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    q_halves = (q[:, :half], q[:, half:])
+    q_idx = (me, c - 1 - me)  # chunk indices of my query halves
+
+    def pair(qh, kh, vh, diag):
+        o_b, res = _flash_fwd_impl(
+            qh, kh, vh, diag, scale_v, blk, blk, interpret,
+            out_dtype=jnp.float32,
+        )
+        return o_b, res[4].reshape(b, h, half)
+
+    def half_step(acc, qh, qi, kh, vh, ki):
+        o, lse = acc
+        mode = jnp.where(ki > qi, 0, jnp.where(ki < qi, 1, 2))
+        return lax.switch(
+            mode,
+            [
+                lambda: (o, lse),
+                lambda: _lse_combine(o, lse, *pair(qh, kh, vh, False)),
+                lambda: _lse_combine(o, lse, *pair(qh, kh, vh, True)),
+            ],
+        )
+
+    def step_compute(accs, kb, vb, src):
+        k_halves = (kb[:, :half], kb[:, half:])
+        v_halves = (vb[:, :half], vb[:, half:])
+        k_idx = (src, c - 1 - src)
+        out_accs = []
+        for qh, qi, acc in zip(q_halves, q_idx, accs):
+            for kh, vh, ki in zip(k_halves, v_halves, k_idx):
+                acc = half_step(acc, qh, qi, kh, vh, ki)
+            out_accs.append(acc)
+        return tuple(out_accs)
+
+    def body(carry, t):
+        accs, kb, vb = carry
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        accs = step_compute(accs, kb, vb, (me + t) % n)
+        return (accs, kb, vb), None
+
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+    accs0 = tuple(
+        (
+            _pvary(jnp.zeros((b, half, h, d), jnp.float32), axes),
+            _pvary(jnp.full((b, h, half), _NEG, jnp.float32), axes),
+        )
+        for _ in range(2)
+    )
+    accs = step_compute(accs0, k, v, me)  # own pair, no rotation
+    (accs, _, _), _ = lax.scan(body, (accs, k, v), jnp.arange(1, n))
+    (oa, lse_a), (ob, lse_b) = accs
+    out = jnp.concatenate([oa, ob], axis=1).astype(q.dtype)
+    lse = jnp.concatenate([lse_a, lse_b], axis=2)
+    return out, (q, k, v, out, lse)
+
+
+def _zz_bwd(axis_name, scale, interpret, vary_axes, res, g):
+    from blendjax.ops.flash_attention import (
+        _default_scale,
+        _dkv_pass,
+        _dq_pass,
+        _flat,
+        _unflat,
+    )
+
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    half = s_loc // 2
+    c = 2 * n
+    scale_v = _default_scale(scale, d)
+    blk = _ring_blk(half)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def half_flat(x, i):  # (b, s_loc, h, d) -> flat (bh, half, d) half i
+        return _flat(x[:, i * half:(i + 1) * half])
+
+    qf_h = (half_flat(q, 0), half_flat(q, 1))
+    dof_h = (half_flat(g, 0), half_flat(g, 1))
+    of_h = (half_flat(out, 0), half_flat(out, 1))
+    delta_h = tuple(
+        (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+            -1, keepdims=True
+        )
+        for do, o in zip(dof_h, of_h)
+    )
+    lse_h = (
+        lse[:, :, :half].reshape(b * h, half, 1),
+        lse[:, :, half:].reshape(b * h, half, 1),
+    )
+    q_idx = (me, c - 1 - me)
+
+    def pair_grads(qi_f, kf, vf, dof, lse_f, delta, diag):
+        dq_c = _dq_pass(qi_f, kf, vf, dof, lse_f, delta, diag, scale_v,
+                        blk, blk, interpret, out_dtype=jnp.float32)
+        dk_c, dv_c = _dkv_pass(qi_f, kf, vf, dof, lse_f, delta, diag,
+                               scale_v, blk, blk, interpret,
+                               out_dtype=jnp.float32)
+        return dq_c, dk_c, dv_c
+
+    def step_compute(dqs, dks, dvs, kbf_h, vbf_h, src):
+        k_idx = (src, c - 1 - src)
+        dqs, dks, dvs = list(dqs), list(dks), list(dvs)
+        for a, qi in enumerate(q_idx):
+            for kk, ki in enumerate(k_idx):
+
+                def visible(diag, a=a, kk=kk):
+                    dq_c, dk_c, dv_c = pair_grads(
+                        qf_h[a], kbf_h[kk], vbf_h[kk], dof_h[a],
+                        lse_h[a], delta_h[a], diag,
+                    )
+                    return dqs[a] + dq_c, dks[kk] + dk_c, dvs[kk] + dv_c
+
+                mode = jnp.where(ki > qi, 0, jnp.where(ki < qi, 1, 2))
+                dqs[a], dks[kk], dvs[kk] = lax.switch(
+                    mode,
+                    [
+                        lambda a=a, kk=kk: (dqs[a], dks[kk], dvs[kk]),
+                        lambda: visible(False),
+                        lambda: visible(True),
+                    ],
+                )
+        return tuple(dqs), tuple(dks), tuple(dvs)
+
+    def body(carry, t):
+        dqs, dks, dvs, kbf_h, vbf_h = carry
+        dqs, dks, dvs = step_compute(dqs, dks, dvs, kbf_h, vbf_h,
+                                     (me + t) % n)
+        kbf_h = tuple(lax.ppermute(x, axis_name, perm) for x in kbf_h)
+        vbf_h = tuple(lax.ppermute(x, axis_name, perm) for x in vbf_h)
+        dks = tuple(lax.ppermute(x, axis_name, perm) for x in dks)
+        dvs = tuple(lax.ppermute(x, axis_name, perm) for x in dvs)
+        return (dqs, dks, dvs, kbf_h, vbf_h), None
+
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+
+    def zeros2():
+        return tuple(
+            _pvary(jnp.zeros((b * h, half, d), jnp.float32), axes)
+            for _ in range(2)
+        )
+
+    kbf_h = (half_flat(k, 0), half_flat(k, 1))
+    vbf_h = (half_flat(v, 0), half_flat(v, 1))
+    carry = (zeros2(), zeros2(), zeros2(), kbf_h, vbf_h)
+    (dqs, dks, dvs, kbf_h, vbf_h), _ = lax.scan(
+        body, carry, jnp.arange(n - 1)
+    )
+    # final pair: compute, then rotate ONLY the dK/dV accumulators home
+    dqs, dks, dvs = step_compute(dqs, dks, dvs, kbf_h, vbf_h,
+                                 (me + (n - 1)) % n)
+    dks = tuple(lax.ppermute(x, axis_name, perm) for x in dks)
+    dvs = tuple(lax.ppermute(x, axis_name, perm) for x in dvs)
+
+    def join(halves, dtype):
+        return _unflat(
+            jnp.concatenate(halves, axis=1), b, h
+        ).astype(dtype)
+
+    return (join(dqs, q.dtype), join(dks, k.dtype), join(dvs, v.dtype))
+
+
+zigzag_flash_attention.defvjp(_zz_fwd, _zz_bwd)
+
+
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
                       inner_attn=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
@@ -380,6 +617,20 @@ def make_ring_attention(
             return ring_flash_attention(
                 q, k, v, _axis, causal, None, _interp, _vary
             )
+    elif impl == "zigzag_flash":
+        if not causal:
+            raise ValueError(
+                "zigzag_flash balances the CAUSAL ring's load; a "
+                "non-causal ring has no imbalance — use ring_flash"
+            )
+        if flash_interpret is None:
+            flash_interpret = jax.default_backend() != "tpu"
+
+        def inner(q, k, v, _axis=seq_axis, _vary=vary,
+                  _interp=flash_interpret):
+            return zigzag_flash_attention(
+                q, k, v, _axis, None, _interp, _vary
+            )
     elif impl == "ulysses":
         if head_axis is not None:
             raise ValueError("ulysses uses the head dim for its all-to-all; "
@@ -387,10 +638,10 @@ def make_ring_attention(
         inner = functools.partial(ulysses_attention, axis_name=seq_axis,
                                   causal=causal, inner_attn=inner_attn)
     else:
-        raise ValueError(f"unknown impl {impl!r} "
-                         "(want 'ring', 'ring_flash' or 'ulysses')")
+        raise ValueError(f"unknown impl {impl!r} (want 'ring', "
+                         "'ring_flash', 'zigzag_flash' or 'ulysses')")
     sm_kwargs = {}
-    if impl == "ring_flash" and flash_interpret:
+    if impl in ("ring_flash", "zigzag_flash") and flash_interpret:
         # The Pallas HLO interpreter's grid-carry slicing trips
         # shard_map's vma typing for non-causal kernel instances (jax
         # 0.9; the error text itself recommends this flag as the
@@ -409,9 +660,24 @@ def make_ring_attention(
             inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
         )
 
+    n_seq = mesh.shape[seq_axis]
+
     def attn(q, k, v):
         sh = NamedSharding(mesh, spec)
+        if impl == "zigzag_flash":
+            # permute the global sequence into zigzag layout so each
+            # contiguous shard holds a balanced (front, back) chunk
+            # pair; undo on the way out.  Models that keep their whole
+            # residual stream zigzag-permuted (with true positions in
+            # the embeddings) can call zigzag_flash_attention directly
+            # and skip these gathers.
+            idx = jnp.asarray(_zigzag_perm(q.shape[1], n_seq))
+            inv = jnp.argsort(idx)
+            q, k, v = (jnp.take(x, idx, axis=1) for x in (q, k, v))
         q, k, v = (lax.with_sharding_constraint(x, sh) for x in (q, k, v))
-        return mapped(q, k, v)
+        out = mapped(q, k, v)
+        if impl == "zigzag_flash":
+            out = jnp.take(out, inv, axis=1)
+        return out
 
     return attn

@@ -149,7 +149,9 @@ def make_seqformer_train_step(
     batch dp-sharded over ``data_axis``, sequence sharded over
     ``seq_axis`` — ``attn_impl`` picks the scheme: ``'ring'`` (blockwise
     ring), ``'ring_flash'`` (the fused Pallas kernel per ring block
-    pair, the long-context configuration), ``'ulysses'`` (all-to-all),
+    pair, the long-context configuration), ``'zigzag_flash'`` (ring +
+    flash with the load-balanced zigzag chunk layout — every device
+    does equal causal work), ``'ulysses'`` (all-to-all),
     or ``'ulysses_flash'`` (all-to-all with the fused kernel as the
     per-head-group inner attention) — attention heads + MLP
     tensor-parallel over ``model_axis`` (ring variants only), MoE
@@ -197,10 +199,12 @@ def make_seqformer_train_step(
         causal=True,
         impl=attn_impl,
         batch_axis=data_axis,
-        head_axis=(model_axis if attn_impl in ("ring", "ring_flash")
+        head_axis=(model_axis
+                   if attn_impl in ("ring", "ring_flash", "zigzag_flash")
                    else None),
         inner_attn=inner_attn,
-        flash_interpret=(flash_interpret if attn_impl == "ring_flash"
+        flash_interpret=(flash_interpret
+                         if attn_impl in ("ring_flash", "zigzag_flash")
                          else None),
     )
     rules = seqformer_rules(model_axis, expert_axis)

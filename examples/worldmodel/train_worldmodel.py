@@ -12,7 +12,8 @@ Modes:
     python train_worldmodel.py --attn flash        # fused Pallas kernel
     python train_worldmodel.py --mesh 2,2,2 --attn ring_flash
         # dp x sp x tp over 8 devices: ring attention with the flash
-        # kernel fused per ring block pair (or ulysses / ulysses_flash)
+        # kernel fused per ring block pair (or zigzag_flash — the
+        # load-balanced causal layout — ulysses / ulysses_flash)
 
 Episodes ride the wire as float16 (half the bytes; a disclosed input-
 precision choice — see seqformer.episode_loss_fn) and obs/target views
@@ -40,7 +41,8 @@ OBS_DIM = 8
 
 
 SINGLE_ATTN = ("full", "flash")
-PARALLEL_ATTN = ("ring", "ring_flash", "ulysses", "ulysses_flash")
+PARALLEL_ATTN = ("ring", "ring_flash", "zigzag_flash", "ulysses",
+                 "ulysses_flash")
 
 
 def episode_transform(batch):

@@ -25,18 +25,23 @@ def qkv():
     return q, k, v
 
 
-@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "zigzag_flash", "ulysses"])
 @pytest.mark.parametrize("causal", [False, True])
 def test_matches_full_attention(qkv, impl, causal):
     q, k, v = qkv
     mesh = make_mesh({"seq": 8})
+    if impl == "zigzag_flash" and not causal:
+        # by design: a non-causal ring has no load imbalance to fix
+        with pytest.raises(ValueError, match="CAUSAL"):
+            make_ring_attention(mesh, causal=causal, impl=impl)
+        return
     attn = make_ring_attention(mesh, causal=causal, impl=impl)
     got = jax.jit(attn)(q, k, v)
     want = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "zigzag_flash", "ulysses"])
 def test_gradients_match(qkv, impl):
     q, k, v = qkv
     mesh = make_mesh({"seq": 8})
@@ -54,7 +59,7 @@ def test_gradients_match(qkv, impl):
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gr), atol=5e-4)
 
 
-@pytest.mark.parametrize("impl", ["ring", "ring_flash", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ring_flash", "zigzag_flash", "ulysses"])
 def test_composes_with_data_parallel(qkv, impl):
     q, k, v = qkv
     mesh = make_mesh({"data": 2, "seq": 4})
@@ -112,6 +117,19 @@ def test_ring_flash_bf16_accumulates_in_f32(qkv):
     q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
     mesh = make_mesh({"seq": 8})
     attn = make_ring_attention(mesh, causal=True, impl="ring_flash")
+    got = np.asarray(jax.jit(attn)(q, k, v)).astype(np.float32)
+    want = np.asarray(full_attention(
+        *(x.astype(jnp.float32) for x in (q, k, v)), causal=True
+    ))
+    np.testing.assert_allclose(got, want, atol=2e-2)
+
+
+def test_zigzag_flash_bf16_accumulates_in_f32(qkv):
+    """Same f32-partials guarantee as ring_flash, through the zigzag
+    layout's 4-pair-per-step combination."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    mesh = make_mesh({"seq": 8})
+    attn = make_ring_attention(mesh, causal=True, impl="zigzag_flash")
     got = np.asarray(jax.jit(attn)(q, k, v)).astype(np.float32)
     want = np.asarray(full_attention(
         *(x.astype(jnp.float32) for x in (q, k, v)), causal=True

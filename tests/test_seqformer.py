@@ -75,7 +75,8 @@ def test_loss_decreases(n_experts):
 
 @pytest.mark.parametrize(
     "n_experts,attn_impl",
-    [(0, "ring"), (0, "ulysses"), (4, "ring"), (4, "ring_flash")],
+    [(0, "ring"), (0, "ulysses"), (4, "ring"), (4, "ring_flash"),
+     (0, "zigzag_flash")],
 )
 def test_sharded_step_matches_single_device(n_experts, attn_impl):
     mesh = make_mesh({"data": 2, "seq": 2, "model": 2})

@@ -284,3 +284,33 @@ def test_flash_attention_32_tile_lowers_for_tpu():
                                 block_kv="auto", interpret=False)
     arg = jax.ShapeDtypeStruct((1, 160, 2, 128), jnp.bfloat16)
     _export_ok(attn, arg, arg, arg)
+
+
+def test_zigzag_flash_sharded_step_lowers_for_tpu():
+    """Compiled zigzag (load-balanced causal ring + flash) sharded step
+    exported for the TPU platform with full vma typing, like its
+    ring_flash sibling."""
+    import numpy as np
+    import optax
+
+    from blendjax.models import seqformer
+    from blendjax.parallel import make_mesh, make_seqformer_train_step
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params = seqformer.init(
+        jax.random.PRNGKey(1), obs_dim=6, d_model=32, n_heads=4,
+        n_layers=1, max_len=32,
+    )
+    init_sf, step, batch_sharding = make_seqformer_train_step(
+        optax.adam(1e-3), mesh, attn_impl="zigzag_flash",
+        flash_interpret=False,
+    )
+    state = init_sf(params)
+    batch = jax.device_put(
+        seqformer.make_episode_batch(
+            np.random.default_rng(0).random((4, 33, 6), np.float32)
+        ),
+        batch_sharding,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state, batch)
+    assert len(exp.mlir_module_serialized) > 0
