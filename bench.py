@@ -324,6 +324,11 @@ def headline(out):
             line["attn"] = seq["attn"]
         if "flash_over_full" in seq:
             line["flash_over_full"] = seq["flash_over_full"]
+        if seq.get("stream_pending") or seq.get("window_skipped"):
+            # banked confirm-first record survived a mid-stream kill, or
+            # the budget expired before the streaming window: the step
+            # verdict is real, the stream window never ran
+            line["seq_partial"] = True
         if seq.get("train_duty_cycle") is not None:
             line["seq_duty"] = seq["train_duty_cycle"]
             if seq.get("duty_cycle_invalid"):
@@ -331,6 +336,10 @@ def headline(out):
     moe = out.get("moe_compare")
     if moe and "topk_over_dense_mixture" in moe:
         line["topk_over_dense"] = moe["topk_over_dense_mixture"]
+        if moe.get("partial"):
+            # banked record survived a kill during mlp/topk_alt: the
+            # ratio is real, the optional variants never ran
+            line["moe_partial"] = True
     return line
 
 
@@ -392,7 +401,7 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
             for k in ("mlp", "dense", "topk", "topk_alt",
                       "topk_over_dense_mixture",
                       "consistent_dense_ge_mlp", "experts", "top_k",
-                      "moe_dispatch")
+                      "moe_dispatch", "partial")
             if k in moe
         }
     if host:
@@ -489,6 +498,8 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
                 "items_per_sec_windows",
                 "stages",
                 "window_skipped",
+                "stream_pending",
+                "batches",
             )
             if k in seq
         }

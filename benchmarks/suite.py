@@ -211,6 +211,12 @@ def main(argv=None):
     ap.add_argument("--fence-every", type=int, default=8)
     ap.add_argument("--attn", choices=["auto", "full", "flash"],
                     default="auto")
+    ap.add_argument("--phase-priority",
+                    choices=["auto", "stream-first", "confirm-first"],
+                    default="auto",
+                    help="forwarded to the device children (see "
+                         "suite_device.py): confirm-first banks the owed "
+                         "kernel verdicts before wire-heavy streams")
     ap.add_argument("--moe-dispatch", choices=["sort", "scatter"],
                     default="sort")
     ap.add_argument("--transport", choices=["tcp", "shm"], default="tcp")
@@ -287,6 +293,7 @@ def main(argv=None):
             "--moe-experts", str(args.moe_experts),
             "--moe-topk", str(args.moe_topk),
             "--moe-dispatch", args.moe_dispatch,
+            "--phase-priority", args.phase_priority,
             "--windows", str(args.windows),
             "--fence-every", str(args.fence_every),
             "--attn", args.attn,

@@ -241,3 +241,28 @@ def test_probe_log_summary(tmp_path):
         "last_ts": "T3b", "last_alive": True, "last_alive_ts": "T3",
     }
     assert probe_log_summary(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_banked_partial_records_disclose_truncation():
+    """A confirm-first device child killed mid-stream leaves banked
+    records (suite_device emits them before the wire-heavy windows); the
+    truncation markers must survive assembly so the artifact cannot pass
+    a truncated phase off as a complete one."""
+    phases = _tpu_phases()
+    seq = phases["seqformer_train"]
+    for k in ("items_per_sec", "batches_per_sec", "tokens_per_sec",
+              "train_duty_cycle", "items_per_sec_windows", "stages"):
+        seq.pop(k)
+    seq.update({"batches": 0, "stream_pending": True,
+                "flash_over_full": 0.71})
+    phases["moe_compare"].pop("mlp")
+    phases["moe_compare"]["partial"] = True
+    out = assemble(phases, rl=None)
+    assert out["seqformer"]["stream_pending"] is True
+    assert out["seqformer"]["batches"] == 0
+    assert out["seqformer"]["flash_over_full"] == 0.71
+    assert out["moe_compare"]["partial"] is True
+    line = headline(out)
+    assert line["seq_partial"] is True
+    assert line["flash_over_full"] == 0.71
+    assert line["topk_over_dense"] == 0.42
