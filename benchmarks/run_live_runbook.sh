@@ -38,16 +38,20 @@ timeout -k 10 600 python bench.py \
 BENCH_RC=$?
 echo "bench rc=$BENCH_RC $(date -u +%H:%M:%S)" >> "$LOG"
 
-# 2. standalone fence validity (full, ~2-3 min)
-timeout -k 10 420 python benchmarks/timing_calibration.py \
-  > "$OUT/r05_fence_calibration_$TS.jsonl" 2>> "$LOG"
-echo "calibration rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
-
-# 3. full fenced suite at the runbook's exact flags
-timeout -k 10 700 python benchmarks/suite_device.py --budget 500 \
+# 2. long direct suite run: warms the persistent compile cache for every
+#    program the driver's bench compiles (the decisive factor — the
+#    01:04 window spent its whole budget on cold compiles) and captures
+#    the full fenced suite; confirm-first ordering banks the owed kernel
+#    verdicts first if the tunnel dies mid-run
+timeout -k 10 1100 python benchmarks/suite_device.py --budget 900 \
   --instances 1 --workers 1 --batch 8 --prefetch 12 --transport shm --raw \
   > "$OUT/r05_suite_device_$TS.jsonl" 2>> "$LOG"
 echo "suite rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
+
+# 3. standalone fence validity (full, ~2-3 min)
+timeout -k 10 420 python benchmarks/timing_calibration.py \
+  > "$OUT/r05_fence_calibration_$TS.jsonl" 2>> "$LOG"
+echo "calibration rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
 
 # 4. best-effort: the judge-runnable acceptance pack (fence validity,
 #    compiled flash <= full, topk <= dense, wire canary) — after the
@@ -56,11 +60,18 @@ timeout -k 10 900 env BLENDJAX_REAL_TPU=1 python -m pytest tests/ -m tpu \
   -q -rs > "$OUT/r05_tpu_acceptance_$TS.txt" 2>&1
 echo "tpu-tests rc=$? $(date -u +%H:%M:%S)" >> "$LOG"
 
-if [ $BENCH_RC -eq 0 ] && grep -q '"device": "tpu"' "$OUT/r05_bench_$TS.json"; then
-  echo "capture SUCCESS (device:tpu in bench artifact); lock kept" >> "$LOG"
+# Success = the owed reading, not merely a TPU-labeled artifact: the
+# 01:04 window produced device:tpu with zero kernel confirmations and
+# the kept lock paused probing for the rest of the window.  Require at
+# least one banked kernel verdict; anything less re-arms.
+if [ $BENCH_RC -eq 0 ] \
+   && grep -q '"device": "tpu"' "$OUT/r05_bench_$TS.json" \
+   && grep -Eq '"flash_over_full"|"topk_over_dense_mixture"' \
+        "$OUT/r05_bench_$TS.json"; then
+  echo "capture SUCCESS (tpu + kernel verdicts in bench artifact); lock kept" >> "$LOG"
 else
-  # window closed before a TPU-labeled bench artifact landed: re-arm so
-  # the next TUNNEL_UP tries again (partial artifacts stay timestamped)
+  # window closed before the owed reading landed: re-arm so the next
+  # TUNNEL_UP tries again (partial artifacts stay timestamped)
   rmdir "$LOCK" 2>/dev/null
   echo "capture INCOMPLETE; lock re-armed" >> "$LOG"
 fi

@@ -266,3 +266,13 @@ def test_banked_partial_records_disclose_truncation():
     assert line["seq_partial"] is True
     assert line["flash_over_full"] == 0.71
     assert line["topk_over_dense"] == 0.42
+    assert line["moe_partial"] is True
+    # the banked shape is the longest headline; it must still fit the
+    # tail window, and the trim may only drop recoverable keys — the
+    # verdict ratios and honesty flags survive
+    s = json.dumps(line)
+    assert len(s) + 1 <= 400, f"headline too long: {len(s)}B"
+    for k in ("metric", "value", "vs_baseline", "fence_ok",
+              "flash_over_full", "seq_partial", "topk_over_dense",
+              "moe_partial"):
+        assert k in line, k
