@@ -301,15 +301,22 @@ HEADLINE_ABBREV = (
     ("wire_efficiency_meaningful", "wire_eff_ok"),
     ("train_duty_cycle", "duty"),
 )
-#: the headline byte ceiling (newline included) and the keys dropped —
-#: in order, only while over the ceiling — to get under it.  'attn' goes
-#: first: whenever the line is long enough to overflow (the banked
-#: partial-record shapes), flash_over_full is present and already
-#: witnesses that the flash kernel ran.  Every key here is recoverable
-#: from the full artifact line; driver fields, verdict ratios, and the
-#: partial/degraded honesty flags are never dropped.
+#: the headline byte ceiling (newline included) and the key GROUPS
+#: dropped — in order, only while over the ceiling — to get under it.
+#: 'attn' goes first: whenever the line is long enough to overflow (the
+#: banked partial-record shapes), flash_over_full is present and
+#: already witnesses that the flash kernel ran.  A value and the
+#: honesty flag qualifying it are dropped TOGETHER (never the flag
+#: alone — a tail reader must not see a number whose 'untrustworthy'
+#: marker was trimmed).  Everything here is recoverable from the full
+#: artifact line; driver fields, the kernel verdict ratios, and the
+#: partial/degraded markers are never dropped.
 HEADLINE_BYTE_BUDGET = 400
-HEADLINE_TRIM_ORDER = ("attn", "wire_eff_ok", "vs_baseline_comparable")
+HEADLINE_TRIM_ORDER = (
+    ("attn",),
+    ("wire_limit", "wire_eff", "wire_eff_ok"),
+    ("duty", "duty_cycle_invalid", "seq_duty", "seq_duty_invalid"),
+)
 
 
 def headline(out):
@@ -349,10 +356,11 @@ def headline(out):
             # banked record survived a kill during mlp/topk_alt: the
             # ratio is real, the optional variants never ran
             line["moe_partial"] = True
-    for k in HEADLINE_TRIM_ORDER:
+    for group in HEADLINE_TRIM_ORDER:
         if len(json.dumps(line)) + 1 <= HEADLINE_BYTE_BUDGET:
             break
-        line.pop(k, None)
+        for k in group:
+            line.pop(k, None)
     return line
 
 

@@ -7,7 +7,9 @@
 set -u
 OUT="$(dirname "$0")/results/r05_tunnel_probes.jsonl"
 mkdir -p "$(dirname "$OUT")"
-INTERVAL="${PROBE_INTERVAL:-600}"
+# 120 s default: live windows can be ~2 min (the 01:04Z window); a
+# 10-minute cadence can miss one entirely
+INTERVAL="${PROBE_INTERVAL:-120}"
 TIMEOUT_S="${PROBE_TIMEOUT:-45}"
 while true; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
