@@ -57,38 +57,106 @@ def flash_block_size(seq_len):
     return next((b for b in (128, 64, 32) if seq_len % b == 0), seq_len)
 
 
-def _block_live(causal, qi, kj, block_q, block_kv):
-    """False only for blocks strictly above the causal diagonal — their
-    probabilities are exactly zero, so compute is skipped (roughly halves
-    the FLOPs of every pass at long context)."""
+def _block_live(causal, qi, kj, block_q, block_kv, window=None):
+    """False for blocks whose probabilities are exactly zero, so compute
+    is skipped: strictly above the causal diagonal (roughly halves the
+    FLOPs at long context), and — under a sliding ``window`` — strictly
+    below it (every key older than ``window`` positions).  The windowed
+    grids are also *shrunk* (see ``_kv_window_steps``): ``kj``/``qi``
+    may then be derived block indices that run past the array, and the
+    two predicates below also correctly kill those overshoot steps (a
+    too-large ``kj`` fails the causal bound; a too-large ``qi`` fails
+    the window bound)."""
     if not causal:
         return True
-    return kj * block_kv <= qi * block_q + (block_q - 1)
+    live = kj * block_kv <= qi * block_q + (block_q - 1)
+    if window is not None:
+        # kv block's newest col must be within `window` of the q block's
+        # oldest row: max_col >= min_row - (window - 1).  qi/kj are traced
+        # program ids, so combine with logical_and, not `and`
+        live = jnp.logical_and(
+            live,
+            kj * block_kv + (block_kv - 1) >= qi * block_q - (window - 1),
+        )
+    return live
 
 
-def _mask(s, i, j, block_q, block_kv):
+def _kv_window_steps(num_kv, block_q, block_kv, window):
+    """Grid steps needed along KV for one q block under a sliding window:
+    the visible span is ``block_q + window - 1`` contiguous positions,
+    which straddles at most ``(span - 2) // block_kv + 2`` KV blocks at
+    worst-case alignment.  This is what makes windowed attention O(T*W)
+    in *grid steps and HBM traffic*, not just FLOPs — without it the
+    grid stays (bh, T/bq, T/bkv) and every dead block still costs a DMA
+    and a grid step."""
+    span = block_q + window - 1
+    return min(num_kv, (span - 2) // block_kv + 2)
+
+
+def _kv_base(i, block_q, block_kv, window):
+    """First KV block index visible to q block ``i`` (floor-clamped to
+    0); traced — used in both the BlockSpec index maps and the kernels'
+    liveness checks."""
+    return jnp.maximum(0, (i * block_q - (window - 1)) // block_kv)
+
+
+def _q_window_steps(num_q, block_q, block_kv, window):
+    """Grid steps along Q for one KV block in the dK/dV pass (rows that
+    can see this block span ``block_kv + window - 1`` positions)."""
+    span = block_kv + window - 1
+    return min(num_q, (span - 2) // block_q + 2)
+
+
+def _q_base(j, block_q, block_kv, window):
+    """First Q block index that can see KV block ``j`` (causal: rows
+    start at the block's own first column)."""
+    del window
+    return (j * block_kv) // block_q
+
+
+def _window_index_map(num_blocks, base_fn):
+    """BlockSpec index map for a shrunk windowed grid axis: the inner
+    grid step maps to block ``base(mid) + step``, clamped onto the last
+    real block (overshoot steps' compute is killed by the kernels'
+    liveness predicates; the clamped DMA is the only waste).  Every
+    pass's windowed axis has this shape — fwd/dQ run ``(bh, q, kv)``
+    with the KV base driven by the q index, dK/dV runs ``(bh, kv, q)``
+    with the Q base driven by the kv index — so one helper keeps the
+    three derivations from desynchronizing."""
+
+    def index_map(bh, mid, inner):
+        return (bh, jnp.minimum(base_fn(mid) + inner, num_blocks - 1), 0)
+
+    return index_map
+
+
+def _mask(s, i, j, block_q, block_kv, window=None):
     rows = i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 0
     )
     cols = j * block_kv + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 1
     )
-    return jnp.where(cols <= rows, s, _NEG)
+    keep = cols <= rows
+    if window is not None:
+        keep = jnp.logical_and(keep, cols > rows - window)
+    return jnp.where(keep, s, _NEG)
 
 
-def _scores(q_ref, k_ref, qi, kj, scale, causal, block_q, block_kv):
+def _scores(q_ref, k_ref, qi, kj, scale, causal, block_q, block_kv,
+            window=None):
     q = q_ref[0].astype(jnp.float32)
     k = k_ref[0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        s = _mask(s, qi, kj, block_q, block_kv)
+        s = _mask(s, qi, kj, block_q, block_kv, window)
     return q, k, s
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-            scale, causal, block_q, block_kv, num_kv):
+            scale, causal, block_q, block_kv, num_kv, window=None):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -98,11 +166,14 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     i = pl.program_id(1)
+    # under a window the grid's kv axis is shrunk: step j maps to actual
+    # kv block base(i) + j (overshoot steps are killed by _block_live)
+    kj = j if window is None else _kv_base(i, block_q, block_kv, window) + j
 
-    @pl.when(_block_live(causal, i, j, block_q, block_kv))
+    @pl.when(_block_live(causal, i, kj, block_q, block_kv, window))
     def _compute():
-        _, _, s = _scores(q_ref, k_ref, i, j, scale, causal, block_q,
-                          block_kv)
+        _, _, s = _scores(q_ref, k_ref, i, kj, scale, causal, block_q,
+                          block_kv, window)
         v = v_ref[0].astype(jnp.float32)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
@@ -131,7 +202,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, block_q, block_kv, num_kv):
+               dq_acc, *, scale, causal, block_q, block_kv, num_kv,
+               window=None):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -139,11 +211,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     i = pl.program_id(1)
+    kj = j if window is None else _kv_base(i, block_q, block_kv, window) + j
 
-    @pl.when(_block_live(causal, i, j, block_q, block_kv))
+    @pl.when(_block_live(causal, i, kj, block_q, block_kv, window))
     def _compute():
-        _, k, s = _scores(q_ref, k_ref, i, j, scale, causal, block_q,
-                          block_kv)
+        _, k, s = _scores(q_ref, k_ref, i, kj, scale, causal, block_q,
+                          block_kv, window)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         p = jnp.exp(s - lse_ref[0].astype(jnp.float32))  # (bq,1) bcast
@@ -164,7 +237,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_kv,
-                num_q):
+                num_q, num_q_total=None, window=None):
     i = pl.program_id(2)  # q-block index is INNERMOST in the dkv pass
 
     @pl.when(i == 0)
@@ -173,11 +246,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     j = pl.program_id(1)
+    qi = i if window is None else _q_base(j, block_q, block_kv, window) + i
+    live = _block_live(causal, qi, j, block_q, block_kv, window)
+    if window is not None:
+        # unlike KV overshoot (killed by the causal bound), a derived qi
+        # past the last real q block still passes both predicates when
+        # the window span runs off the end of the sequence — and would
+        # double-count the clamped block under a phantom-row mask
+        live = jnp.logical_and(live, qi <= num_q_total - 1)
 
-    @pl.when(_block_live(causal, i, j, block_q, block_kv))
+    @pl.when(live)
     def _compute():
-        q, _, s = _scores(q_ref, k_ref, i, j, scale, causal, block_q,
-                          block_kv)
+        q, _, s = _scores(q_ref, k_ref, qi, j, scale, causal, block_q,
+                          block_kv, window)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         p = jnp.exp(s - lse_ref[0].astype(jnp.float32))  # (bq,1) bcast
@@ -240,7 +321,7 @@ def _check_blocks(t, block_q, block_kv):
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
-                    out_dtype=None):
+                    out_dtype=None, window=None):
     """Returns (out (B,T,H,D), flat residuals (qf,kf,vf,of,lse)).
 
     ``out_dtype`` overrides the output dtype (default: q's) — ring_flash
@@ -252,17 +333,27 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
     num_q = t // block_q
     num_kv = t // block_kv
 
+    if window is None:
+        kv_steps = num_kv
+        kv_im = lambda bh, i, j: (bh, j, 0)
+    else:
+        # shrunk grid: O(window) kv steps per q block
+        kv_steps = _kv_window_steps(num_kv, block_q, block_kv, window)
+        kv_im = _window_index_map(
+            num_kv, lambda i: _kv_base(i, block_q, block_kv, window)
+        )
+
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, num_kv=num_kv,
+        block_kv=block_kv, num_kv=kv_steps, window=window,
     )
     of, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, num_q, num_kv),
+        grid=(b * h, num_q, kv_steps),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_im),
+            pl.BlockSpec((1, block_kv, d), kv_im),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -282,33 +373,53 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
     return _unflat(of, b, h), (qf, kf, vf, of, lse)
 
 
+def _check_window(causal, window):
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window (sliding-window attention) requires causal=True")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_kv=128, interpret=False):
+                    block_kv=128, interpret=False, window=None):
     """Fused block-wise attention; same contract as ``full_attention``:
     q/k/v (B, T, H, D) -> (B, T, H, D).
 
     ``T`` must divide by both block sizes (pick blocks accordingly or pad
     upstream).  ``interpret=True`` runs on CPU (CI parity tests).
+
+    ``window=W`` (requires ``causal=True``) is sliding-window attention:
+    each query attends to its own and the previous ``W - 1`` positions.
+    ``W`` is static, so every pass (forward, dQ, dK/dV) *shrinks its
+    grid*: the KV (resp. Q) axis runs only the ~``W / block`` blocks a
+    block can see, with a per-block base offset in the BlockSpec index
+    map — grid steps, DMA traffic, and FLOPs all scale O(T*W) instead
+    of O(T^2/2).
     """
+    _check_window(causal, window)
     scale = _default_scale(scale, q.shape[-1])
     out, _ = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv,
-                             interpret)
+                             interpret, window=window)
     return out
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _fwd(q, k, v, causal, scale, block_q, block_kv, interpret, window):
+    _check_window(causal, window)
     scale_v = _default_scale(scale, q.shape[-1])
     out, res = _flash_fwd_impl(
-        q, k, v, causal, scale_v, block_q, block_kv, interpret
+        q, k, v, causal, scale_v, block_q, block_kv, interpret,
+        window=window
     )
     return out, res + (q.shape,)
 
 
 def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
-             block_kv, interpret, out_dtype=None):
+             block_kv, interpret, out_dtype=None, window=None):
     """dQ for one (Tq, Tk) pair of flat arrays — used over the full
     sequence by :func:`flash_attention`'s vjp and per ring-block pair by
     :func:`blendjax.parallel.ring_attention.ring_flash_attention` (which
@@ -317,15 +428,23 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     bh, tq, d = qf.shape
     tk = kf.shape[1]
     num_q, num_kv = tq // block_q, tk // block_kv
+    if window is None:
+        kv_steps = num_kv
+        kv_im = lambda bh, i, j: (bh, j, 0)
+    else:
+        kv_steps = _kv_window_steps(num_kv, block_q, block_kv, window)
+        kv_im = _window_index_map(
+            num_kv, lambda i: _kv_base(i, block_q, block_kv, window)
+        )
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    kv_spec_j = pl.BlockSpec((1, block_kv, d), lambda bh, i, j: (bh, j, 0))
+    kv_spec_j = pl.BlockSpec((1, block_kv, d), kv_im)
     row_spec_i = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
     return pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_kv=block_kv, num_kv=num_kv,
+            block_kv=block_kv, num_kv=kv_steps, window=window,
         ),
-        grid=(bh, num_q, num_kv),
+        grid=(bh, num_q, kv_steps),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
                   row_spec_i],
         out_specs=q_spec_i,
@@ -336,21 +455,30 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
 
 
 def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
-              block_kv, interpret, out_dtype=None):
+              block_kv, interpret, out_dtype=None, window=None):
     """dK/dV for one (Tq, Tk) pair: kv blocks in the MIDDLE grid dim, q
     blocks INNERMOST so the accumulators carry across q steps."""
     bh, tq, d = qf.shape
     tk = kf.shape[1]
     num_q, num_kv = tq // block_q, tk // block_kv
-    q_spec_inner = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    if window is None:
+        q_steps = num_q
+        q_im = lambda bh, j, i: (bh, i, 0)
+    else:
+        q_steps = _q_window_steps(num_q, block_q, block_kv, window)
+        q_im = _window_index_map(
+            num_q, lambda j: _q_base(j, block_q, block_kv, window)
+        )
+    q_spec_inner = pl.BlockSpec((1, block_q, d), q_im)
     kv_spec_mid = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
-    row_spec_inner = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
+    row_spec_inner = pl.BlockSpec((1, block_q, 1), q_im)
     return pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_kv=block_kv, num_q=num_q,
+            block_kv=block_kv, num_q=q_steps, num_q_total=num_q,
+            window=window,
         ),
-        grid=(bh, num_kv, num_q),
+        grid=(bh, num_kv, q_steps),
         in_specs=[q_spec_inner, kv_spec_mid, kv_spec_mid, q_spec_inner,
                   row_spec_inner, row_spec_inner],
         out_specs=[kv_spec_mid, kv_spec_mid],
@@ -363,7 +491,7 @@ def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     )(qf, kf, vf, dof, lse, delta)
 
 
-def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
+def _bwd(causal, scale, block_q, block_kv, interpret, window, res, g):
     qf, kf, vf, of, lse, qshape = res
     b, t, h, d = qshape
     scale_v = _default_scale(scale, d)
@@ -374,9 +502,9 @@ def _bwd(causal, scale, block_q, block_kv, interpret, res, g):
         -1, keepdims=True
     )
     dq = _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale_v, block_q,
-                  block_kv, interpret)
+                  block_kv, interpret, window=window)
     dk, dv = _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale_v,
-                       block_q, block_kv, interpret)
+                       block_q, block_kv, interpret, window=window)
     return (_unflat(dq, b, h), _unflat(dk, b, h), _unflat(dv, b, h))
 
 
@@ -384,7 +512,7 @@ flash_attention.defvjp(_fwd, _bwd)
 
 
 def make_flash_attention(causal=True, block_q=128, block_kv=128,
-                         interpret=False):
+                         interpret=False, window=None):
     """``attn_fn`` closure for :func:`blendjax.models.seqformer.apply` —
     drop-in for the default ``full_attention``.
 
@@ -394,7 +522,11 @@ def make_flash_attention(causal=True, block_q=128, block_kv=128,
     single tile) instead of requiring T to divide a fixed block.  Ragged
     lengths beyond that are rejected — the only "tile" dividing them is
     T itself, which would materialize the (T, T) score block the kernel
-    exists to avoid (pad upstream instead)."""
+    exists to avoid (pad upstream instead).
+
+    ``window=W`` enables sliding-window attention (causal only; see
+    :func:`flash_attention`)."""
+    _check_window(causal, window)
 
     def attn(q, k, v):
         t = q.shape[1]
@@ -408,7 +540,7 @@ def make_flash_attention(causal=True, block_q=128, block_kv=128,
         bq = auto if block_q == "auto" else block_q
         bkv = auto if block_kv == "auto" else block_kv
         return flash_attention(
-            q, k, v, causal, None, bq, bkv, interpret
+            q, k, v, causal, None, bq, bkv, interpret, window
         )
 
     return attn

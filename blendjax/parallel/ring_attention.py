@@ -75,13 +75,22 @@ def _pvary(x, axes):
     return x
 
 
-def full_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
+def full_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
+                   window=None):
     """Plain softmax attention; the single-device reference implementation.
 
     q: (B, Sq, H, D), k/v: (B, Sk, H, D).  ``*_offset`` give the global
     position of element 0 along the sequence axis (used by the parallel
-    schemes for causal masking across shards).
+    schemes for causal masking across shards).  ``window=W`` (causal
+    only) is sliding-window attention: query i sees keys in
+    ``(i - W, i]`` — the reference semantics for
+    ``blendjax.ops.flash_attention``'s windowed kernel.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -89,6 +98,8 @@ def full_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0):
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         mask = kpos[None, :] <= qpos[:, None]
+        if window is not None:
+            mask &= kpos[None, :] > qpos[:, None] - window
         scores = jnp.where(mask[None, None], scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

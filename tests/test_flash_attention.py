@@ -118,6 +118,108 @@ def test_seqformer_attn_fn_integration():
     )
 
 
+@pytest.mark.parametrize("window", [1, 5, 64, 96, 1000])
+def test_sliding_window_forward_matches_reference(window):
+    """window=W spans every regime: sub-block (1, 5), exactly one block
+    (64), block-straddling (96), and wider-than-T (1000, == plain
+    causal)."""
+    q, k, v = _qkv(t=256, d=32)
+    out = flash_attention(q, k, v, True, None, 64, 64, True, window)
+    ref = full_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_sliding_window_wider_than_t_equals_plain_causal():
+    q, k, v = _qkv(t=128, d=32)
+    windowed = flash_attention(q, k, v, True, None, 64, 64, True, 1000)
+    plain = flash_attention(q, k, v, True, None, 64, 64, True)
+    np.testing.assert_allclose(np.asarray(windowed), np.asarray(plain))
+
+
+@pytest.mark.parametrize("window", [5, 96])
+def test_sliding_window_gradients_match_reference(window):
+    q, k, v = _qkv(t=128, d=32)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(q, k, v, True, None, 64, 32, True, window) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (full_attention(q, k, v, causal=True, window=window) ** 2).sum()
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_sliding_window_shrinks_grid():
+    """The windowed grids really are O(W), not O(T): step counts drop
+    below the full block count, and parity holds with the shrunk grids
+    active in ALL THREE passes (incl. the end-of-sequence overshoot rows
+    where a derived q index past the last real block must be dead, not
+    double-counted)."""
+    from blendjax.ops.flash_attention import (
+        _kv_window_steps,
+        _q_window_steps,
+    )
+
+    # t=384, blocks 64: 6 full blocks; W=96 needs only 4 steps
+    assert _kv_window_steps(6, 64, 64, 96) == 4
+    assert _q_window_steps(6, 64, 64, 96) == 4
+    # W wider than T: clamped to the full grid
+    assert _kv_window_steps(6, 64, 64, 10_000) == 6
+
+    q, k, v = _qkv(b=1, t=384, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(q, k, v, True, None, 64, 64, True, 96) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (full_attention(q, k, v, causal=True, window=96) ** 2).sum()
+
+    out = flash_attention(q, k, v, True, None, 64, 64, True, 96)
+    ref = full_attention(q, k, v, causal=True, window=96)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_sliding_window_requires_causal():
+    q, k, v = _qkv(t=64, d=16)
+    with pytest.raises(ValueError, match="requires causal"):
+        flash_attention(q, k, v, False, None, 64, 64, True, 8)
+    with pytest.raises(ValueError, match="requires causal"):
+        make_flash_attention(causal=False, window=8)
+    with pytest.raises(ValueError, match="window requires causal"):
+        full_attention(q, k, v, causal=False, window=8)
+
+
+def test_make_flash_attention_window_closure():
+    """The factory threads window through to the kernel (seqformer seam)."""
+    q, k, v = _qkv(t=128, d=32)
+    attn = make_flash_attention(causal=True, block_q=64, block_kv=64,
+                                interpret=True, window=48)
+    np.testing.assert_allclose(
+        np.asarray(attn(q, k, v)),
+        np.asarray(full_attention(q, k, v, causal=True, window=48)),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
 def test_make_flash_attention_auto_tiles_to_sequence():
     """block='auto' sizes the tile per call via flash_block_size, so the
     closure works at lengths a fixed 128 block would reject."""

@@ -38,6 +38,23 @@ def test_flash_attention_fwd_bwd_lowers_for_tpu():
     _export_ok(jax.value_and_grad(loss, argnums=(0, 1, 2)), arg, arg, arg)
 
 
+def test_flash_attention_sliding_window_lowers_for_tpu():
+    """Windowed (sliding) attention adds a second grid-level skip
+    predicate (below-window blocks) to every pass — fwd, dQ, dK/dV must
+    all still clear Mosaic with it."""
+    from blendjax.ops.flash_attention import flash_attention
+
+    B, T, H, D = 1, 512, 2, 128
+
+    def loss(q, k, v):
+        return flash_attention(
+            q, k, v, True, None, 128, 128, False, 192
+        ).sum()
+
+    arg = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+    _export_ok(jax.value_and_grad(loss, argnums=(0, 1, 2)), arg, arg, arg)
+
+
 def test_flash_attention_small_head_dim_lowers_for_tpu():
     """d=64 < 128 lanes: legal only via the 'equal to the array dim'
     clause of the tiling rule — the multichip dryrun composes the kernel
