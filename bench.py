@@ -356,6 +356,20 @@ def headline(out):
             # banked record survived a kill during mlp/topk_alt: the
             # ratio is real, the optional variants never ran
             line["moe_partial"] = True
+    # bare-kernel fallbacks: surface only when the stronger train-step
+    # ratio is absent (short window banked the micro verdict alone)
+    ka = out.get("kernel_attn")
+    if ka and "flash_over_full" not in line:
+        if "flash_over_full_kernel" in ka:
+            line["flash_over_full_kernel"] = ka["flash_over_full_kernel"]
+        elif "flash_step_ms" in ka and ka.get("flash_compiled"):
+            # flash ran compiled on this device even if the full-attn
+            # comparison never landed
+            line["flash_kernel_ran"] = True
+    km = out.get("kernel_moe")
+    if km and "topk_over_dense" not in line \
+            and "topk_over_dense_kernel" in km:
+        line["topk_over_dense_kernel"] = km["topk_over_dense_kernel"]
     for group in HEADLINE_TRIM_ORDER:
         if len(json.dumps(line)) + 1 <= HEADLINE_BYTE_BUDGET:
             break
@@ -425,6 +439,39 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
                       "moe_dispatch", "partial")
             if k in moe
         }
+    # bare-kernel verdicts (suite phase_kernel_microverdicts): the
+    # cheapest on-chip witnesses of flash<=full / topk<=dense, banked
+    # minutes into a live window — kept alongside (never instead of)
+    # the train-step-level ratios, which supersede them in the headline
+    kflash = pick("kernel_flash")
+    kff = pick("kernel_flash_vs_full")
+    if kflash or kff:
+        ka = {}
+        if kflash:
+            ka["flash_step_ms"] = round(
+                kflash["step_stats"]["step_s"] * 1e3, 3
+            )
+            ka["flash_compiled"] = kflash.get("compiled")
+        if kff:
+            for k in ("flash_step_ms", "full_step_ms",
+                      "flash_over_full_kernel"):
+                if k in kff:
+                    ka[k] = kff[k]
+        extras["kernel_attn"] = ka
+    ktopk = pick("kernel_topk")
+    ktd = pick("kernel_topk_vs_dense")
+    if ktopk or ktd:
+        km = {}
+        if ktopk:
+            km["topk_step_ms"] = round(
+                ktopk["step_stats"]["step_s"] * 1e3, 3
+            )
+        if ktd:
+            for k in ("topk_step_ms", "dense_step_ms",
+                      "topk_over_dense_kernel"):
+                if k in ktd:
+                    km[k] = ktd[k]
+        extras["kernel_moe"] = km
     if host:
         extras["host_stream_images_per_sec"] = host["items_per_sec"]
     if hbm:

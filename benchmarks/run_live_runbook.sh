@@ -109,7 +109,7 @@ fi
 # least one banked kernel verdict; anything less re-arms.
 if [ $BENCH_RC -eq 0 ] \
    && grep -q '"device": "tpu"' "$OUT/r05_bench_$TS.json" \
-   && grep -Eq '"flash_over_full"|"topk_over_dense_mixture"' \
+   && grep -Eq '"flash_over_full"|"topk_over_dense_mixture"|"flash_over_full_kernel"|"topk_over_dense_kernel"' \
         "$OUT/r05_bench_$TS.json"; then
   echo "capture SUCCESS (tpu + kernel verdicts in bench artifact); lock kept" >> "$LOG"
 else

@@ -92,6 +92,19 @@ def note(msg):
     _note(msg, who="suite-device")
 
 
+_T0 = time.monotonic()
+
+
+def progress(at):
+    """Timestamped heartbeat record before every long compile.  The
+    03:17Z live window died mid-phase with nothing between the canary
+    record and the timeout — 16 blind minutes.  These markers make a
+    dead window's artifact say WHERE the time went (consumers ignore
+    the ``progress`` phase)."""
+    emit({"phase": "progress", "at": at,
+          "t_s": round(time.monotonic() - _T0, 1)})
+
+
 def peak_flops():
     import jax
 
@@ -509,6 +522,164 @@ def phase_put_strategy(args, budget, tag):
     })
 
 
+def phase_kernel_microverdicts(args, budget, tag):
+    """Bare-kernel verdicts that compile in a fraction of the train-step
+    time — the cheapest possible on-chip witnesses of the two owed
+    confirmations (compiled flash <= full, routed topk <= dense).
+
+    Round 5's 03:17Z live window motivated this: the confirm-first
+    seqformer phase never finished its first train-step compile (8-layer
+    d=1024 fwd+bwd+adam over the tunnel) before the relay died ~16 min
+    in, so the window banked nothing past the canary.  This phase times
+    the kernels THEMSELVES — one attention (or one MoE layer) fwd+bwd
+    chained step at the same shapes the train step uses — so a verdict
+    lands within the first minutes of a window.  The train-step-level
+    ratios from phase_seqformer/phase_moe_compare remain the stronger
+    claim and supersede these in the headline when present.
+
+    Each sub-verdict emits the moment it exists (kernel_flash alone is
+    already the 'flash compiled and ran on chip' witness); a mid-phase
+    relay death keeps everything banked so far."""
+    if not budget.has(60, "kernel_microverdicts"):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from blendjax.models.seqformer import _moe_apply, _moe_init
+    from blendjax.models.moe import moe_apply_topk
+    from blendjax.ops.flash_attention import make_flash_attention
+    from blendjax.parallel.ring_attention import full_attention
+
+    T = args.seq_len - 1
+    H, D = args.n_heads, args.d_model // args.n_heads
+    B = 2
+    interpret = tag["platform"] != "tpu"
+
+    def attn_step_fn(attn):
+        def loss(q, k, v):
+            return (attn(q, k, v).astype(jnp.float32) ** 2).mean()
+
+        grad = jax.value_and_grad(loss, argnums=(0, 1, 2))
+
+        def step(state, _):
+            q, k, v = state
+            l, (gq, gk, gv) = grad(q, k, v)
+            lr = jnp.asarray(1e-3, q.dtype)
+            return (q - lr * gq, k - lr * gk, v - lr * gv), l
+
+        return jax.jit(step)
+
+    flash_ms = None
+    qkv = None
+    run_attn = (not args.skip_seqformer and T % 32 == 0
+                and budget.has(45, "kernel_flash"))
+    if run_attn:
+        # inputs built only once this measurement is definitely running:
+        # on a budget-starved window the device must not pay for tensors
+        # nothing will use
+        qkv = tuple(
+            jax.random.normal(k, (B, T, H, D), jnp.bfloat16)
+            for k in jax.random.split(jax.random.PRNGKey(0), 3)
+        )
+        progress("kernel_flash_compile")
+        try:
+            flash = make_flash_attention(
+                causal=True, block_q="auto", block_kv="auto",
+                interpret=interpret,
+            )
+            stats, _ = measure_step_time(
+                attn_step_fn(flash), qkv, None, budget,
+                windows=args.windows,
+            )
+            flash_ms = stats["step_s"] * 1e3
+            emit({"phase": "kernel_flash", "step_stats": stats,
+                  "seq_len": T, "heads": H, "head_dim": D, "batch": B,
+                  "compiled": not interpret, **tag})
+        except Exception as e:  # noqa: BLE001 - bank what exists
+            note(f"kernel_flash failed: {type(e).__name__}: {e}")
+
+    if flash_ms is not None and budget.has(45, "kernel_full_attn"):
+        progress("kernel_full_attn_compile")
+        try:
+            full = lambda q, k, v: full_attention(q, k, v, causal=True)
+            stats, _ = measure_step_time(
+                attn_step_fn(full), qkv, None, budget,
+                windows=args.windows,
+            )
+            full_ms = stats["step_s"] * 1e3
+            emit({"phase": "kernel_flash_vs_full",
+                  "flash_step_ms": round(flash_ms, 3),
+                  "full_step_ms": round(full_ms, 3),
+                  "flash_over_full_kernel": round(
+                      flash_ms / max(full_ms, 1e-9), 4
+                  ),
+                  "seq_len": T, "heads": H, "head_dim": D, "batch": B,
+                  **tag})
+        except Exception as e:  # noqa: BLE001
+            note(f"kernel_full_attn failed: {type(e).__name__}: {e}")
+
+    def moe_step_fn(apply_fn):
+        def loss(x, p):
+            return (apply_fn(p, x).astype(jnp.float32) ** 2).mean()
+
+        grad = jax.value_and_grad(loss)
+
+        def step(x, p):
+            l, gx = grad(x, p)
+            return x - jnp.asarray(1e-3, x.dtype) * gx, l
+
+        return jax.jit(step)
+
+    # one MoE layer fwd+bwd, routed topk vs the dense mixture, same
+    # parameter pytree (routing is an apply-time choice)
+    topk_ms = None
+    p = x = None
+    if not args.skip_moe and budget.has(45, "kernel_topk"):
+        p = _moe_init(jax.random.PRNGKey(1), args.moe_experts,
+                      args.d_model, 4 * args.d_model)
+        x = jax.random.normal(
+            jax.random.PRNGKey(2), (B, T, args.d_model), jnp.bfloat16
+        )
+        progress("kernel_topk_compile")
+        try:
+            topk_apply = lambda p, x: moe_apply_topk(
+                p, x, jnp.bfloat16, k=args.moe_topk,
+                dispatch=args.moe_dispatch,
+            )[0]
+            stats, _ = measure_step_time(
+                moe_step_fn(topk_apply), x, p, budget,
+                windows=args.windows,
+            )
+            topk_ms = stats["step_s"] * 1e3
+            emit({"phase": "kernel_topk", "step_stats": stats,
+                  "experts": args.moe_experts, "top_k": args.moe_topk,
+                  "moe_dispatch": args.moe_dispatch,
+                  "d_model": args.d_model, "tokens": B * T, **tag})
+        except Exception as e:  # noqa: BLE001
+            note(f"kernel_topk failed: {type(e).__name__}: {e}")
+
+    if topk_ms is not None and budget.has(45, "kernel_dense_moe"):
+        progress("kernel_dense_moe_compile")
+        try:
+            dense_apply_fn = lambda p, x: _moe_apply(p, x, jnp.bfloat16)
+            stats, _ = measure_step_time(
+                moe_step_fn(dense_apply_fn), x, p, budget,
+                windows=args.windows,
+            )
+            dense_ms = stats["step_s"] * 1e3
+            emit({"phase": "kernel_topk_vs_dense",
+                  "topk_step_ms": round(topk_ms, 3),
+                  "dense_step_ms": round(dense_ms, 3),
+                  "topk_over_dense_kernel": round(
+                      topk_ms / max(dense_ms, 1e-9), 4
+                  ),
+                  "experts": args.moe_experts, "top_k": args.moe_topk,
+                  "moe_dispatch": args.moe_dispatch,
+                  "d_model": args.d_model, "tokens": B * T, **tag})
+        except Exception as e:  # noqa: BLE001
+            note(f"kernel_dense_moe failed: {type(e).__name__}: {e}")
+
+
 def phase_cube_stream(args, budget, producers, tag):
     """Phases 1+2: cube640x480 stream -> HBM, then -> detector train."""
     import jax
@@ -745,6 +916,7 @@ def phase_seqformer(args, budget, launch, tag, confirm_first=False):
         }
         warm_dev = jax.device_put(warm)
         tC = time.perf_counter()
+        progress(f"seqformer_{attn_name}_train_step_compile")
         try:
             step_stats, state = measure_step_time(
                 train_step, state, warm_dev, budget, windows=args.windows
@@ -780,6 +952,7 @@ def phase_seqformer(args, budget, launch, tag, confirm_first=False):
                     75, "seqformer full-attn comparison (extra compile)"):
                 return {}
             try:
+                progress("seqformer_full_train_step_compile")
                 full_step = make_train_step(seqformer.episode_loss_fn, opt)
                 full_state = TrainState.create(
                     seqformer.init(jax.random.PRNGKey(0), **kwargs), opt
@@ -988,6 +1161,7 @@ def phase_moe_compare(args, budget, tag):
         state = TrainState.create(params, opt)
         train_step = make_train_step(loss, opt)
         tC = time.perf_counter()
+        progress(f"moe_{variant}_train_step_compile")
         try:
             step_stats, state = measure_step_time(
                 train_step, state, warm_dev, budget, windows=args.windows
@@ -1235,13 +1409,15 @@ def main(argv=None):
         "moe phase", lambda: phase_moe_compare(args, budget, tag))
     cube = ("cube phases", cube_phases)
     strat = ("put_strategy", lambda: phase_put_strategy(args, budget, tag))
+    micro = ("kernel microverdicts",
+             lambda: phase_kernel_microverdicts(args, budget, tag))
 
     # trust anchor + wire ceiling always lead; after that, confirm-first
-    # (the tunneled TPU) banks BOTH owed kernel verdicts — seqformer
-    # flash<=full, then moe topk<=dense — before any wire-heavy stream
-    # window runs (phase_seqformer defers its stream to a continuation):
-    # round-5's first live window died ~2 min in with nothing but the
-    # fence phase captured
+    # (the tunneled TPU) banks the owed kernel verdicts cheapest-first:
+    # bare-kernel ratios (minutes of compile) before the train-step
+    # ratios (the 03:17Z window died inside the seqformer phase's FIRST
+    # train-step compile, ~16 min in, with nothing banked past the
+    # canary), both before any wire-heavy stream window
     run_phase("fence_validation",
               lambda: phase_fence_validation(args, budget, tag))
     run_phase("tunnel_canary",
@@ -1249,7 +1425,7 @@ def main(argv=None):
     if confirm_first:
         # put_strategy is TPU-only and cheap (30s-gated): it goes right
         # after the banked verdicts, before any wire-heavy stream
-        order = [seq, moe, strat, cube, seq_stream]
+        order = [micro, seq, moe, strat, cube, seq_stream]
     else:
         # stream-first: run_seq executes the stream inline (no deferred
         # continuation), so seq_stream is a no-op here

@@ -243,6 +243,61 @@ def test_probe_log_summary(tmp_path):
     assert probe_log_summary(str(tmp_path / "missing.jsonl")) is None
 
 
+def test_kernel_microverdicts_carry_and_headline_fallback():
+    """Bare-kernel verdict records (phase_kernel_microverdicts) ride the
+    artifact; in the headline they surface ONLY when the stronger
+    train-step ratio is absent — a window that banked nothing but the
+    micro verdicts still reports them in the tail."""
+    phases = _tpu_phases()
+    phases["kernel_flash"] = {
+        "phase": "kernel_flash", "platform": "tpu", "compiled": True,
+        "step_stats": {"step_s": 0.012, "fence": "value_fetch"},
+        "seq_len": 512, "heads": 8, "head_dim": 128, "batch": 2,
+    }
+    phases["kernel_flash_vs_full"] = {
+        "phase": "kernel_flash_vs_full", "platform": "tpu",
+        "flash_step_ms": 12.0, "full_step_ms": 19.0,
+        "flash_over_full_kernel": 0.6316,
+    }
+    phases["kernel_topk_vs_dense"] = {
+        "phase": "kernel_topk_vs_dense", "platform": "tpu",
+        "topk_step_ms": 8.0, "dense_step_ms": 21.0,
+        "topk_over_dense_kernel": 0.381,
+    }
+    out = assemble(phases, rl=None)
+    assert out["kernel_attn"]["flash_over_full_kernel"] == 0.6316
+    assert out["kernel_attn"]["flash_compiled"] is True
+    assert out["kernel_moe"]["topk_over_dense_kernel"] == 0.381
+
+    # train-step ratios present: the headline keeps the stronger claim
+    out["seqformer"]["flash_over_full"] = 0.71
+    line = headline(out)
+    assert "flash_over_full_kernel" not in line
+    assert "topk_over_dense_kernel" not in line  # moe ratio present
+
+    # micro-only window: kernel ratios surface in the tail line
+    out2 = assemble(
+        {k: v for k, v in phases.items()
+         if k not in ("seqformer_train", "moe_compare")},
+        rl=None,
+    )
+    line2 = headline(out2)
+    assert line2["flash_over_full_kernel"] == 0.6316
+    assert line2["topk_over_dense_kernel"] == 0.381
+    assert len(json.dumps(line2)) + 1 <= 400
+
+    # flash ran compiled but the full-attn comparison never landed:
+    # the witness alone still reaches the tail
+    out3 = assemble(
+        {k: v for k, v in phases.items()
+         if k not in ("seqformer_train", "moe_compare",
+                      "kernel_flash_vs_full", "kernel_topk_vs_dense")},
+        rl=None,
+    )
+    line3 = headline(out3)
+    assert line3["flash_kernel_ran"] is True
+
+
 def test_banked_partial_records_disclose_truncation():
     """A confirm-first device child killed mid-stream leaves banked
     records (suite_device emits them before the wire-heavy windows); the

@@ -153,6 +153,58 @@ def test_stats_min_median_max():
     assert (s["min"], s["median"], s["max"], s["n"]) == (1.0, 2.0, 3.0, 3)
 
 
+def test_phase_kernel_microverdicts_banks_incrementally(capsys):
+    """The bare-kernel verdict phase emits one record per measurement
+    the moment it exists (kernel_flash -> kernel_flash_vs_full ->
+    kernel_topk -> kernel_topk_vs_dense), each preceded by a progress
+    heartbeat — a relay death at any point keeps everything banked so
+    far.  Tiny shapes; interpret-mode flash off-TPU."""
+    import argparse
+    import json
+
+    from benchmarks.suite_device import phase_kernel_microverdicts
+
+    args = argparse.Namespace(
+        seq_len=33, n_heads=2, d_model=32, windows=1,
+        moe_experts=4, moe_topk=2, moe_dispatch="sort",
+        skip_seqformer=False, skip_moe=False,
+    )
+    tag = {"platform": "cpu", "config": "small"}
+    phase_kernel_microverdicts(args, Budget(600), tag)
+    lines = [json.loads(s) for s in
+             capsys.readouterr().out.strip().splitlines()]
+    by_phase = {}
+    order = []
+    for l in lines:
+        by_phase[l["phase"]] = l
+        order.append(l["phase"])
+
+    # every measurement record banked, heartbeat before each compile
+    for ph in ("kernel_flash", "kernel_flash_vs_full", "kernel_topk",
+               "kernel_topk_vs_dense"):
+        assert ph in by_phase, order
+    assert order.count("progress") == 4
+    assert order.index("kernel_flash") < order.index("kernel_topk")
+
+    kf = by_phase["kernel_flash"]
+    assert kf["compiled"] is False  # interpret mode off-TPU
+    assert kf["step_stats"]["step_s"] > 0
+    assert kf["step_stats"]["fence"] == "value_fetch"
+    kff = by_phase["kernel_flash_vs_full"]
+    assert kff["flash_over_full_kernel"] > 0
+    assert kff["flash_step_ms"] > 0 and kff["full_step_ms"] > 0
+    ktd = by_phase["kernel_topk_vs_dense"]
+    assert ktd["topk_over_dense_kernel"] > 0
+    assert ktd["experts"] == 4 and ktd["top_k"] == 2
+
+    # operator skip flags suppress the matching halves (and their input
+    # tensors are then never built)
+    args.skip_seqformer = True
+    args.skip_moe = True
+    phase_kernel_microverdicts(args, Budget(600), tag)
+    assert capsys.readouterr().out == ""
+
+
 def test_phase_put_strategy_emits_winner_and_loser(capsys):
     """The transfer-granularity probe ships winner AND loser; gated to
     tpu-tagged runs (on loopback it measures dispatch, not a strategy).
