@@ -237,7 +237,12 @@ def main(argv=None):
     ap.add_argument("--obs-dim", type=int, default=32)
     ap.add_argument("--d-model", type=int, default=1024)
     ap.add_argument("--n-heads", type=int, default=8)
-    ap.add_argument("--n-layers", type=int, default=8)
+    # None sentinel, forwarded only when set: the child's apply_config
+    # owns the default (8 big / 2 small) and its confirm-first
+    # tunneled-TPU path downshifts an UNSET depth to the live-window
+    # sizing — an unconditional "--n-layers 8" here would read as an
+    # explicit operator choice and defeat both
+    ap.add_argument("--n-layers", type=int, default=None)
     ap.add_argument("--moe-experts", type=int, default=8)
     ap.add_argument("--moe-topk", type=int, default=2)
     args = ap.parse_args(argv)
@@ -289,7 +294,6 @@ def main(argv=None):
             "--obs-dim", str(args.obs_dim),
             "--d-model", str(args.d_model),
             "--n-heads", str(args.n_heads),
-            "--n-layers", str(args.n_layers),
             "--moe-experts", str(args.moe_experts),
             "--moe-topk", str(args.moe_topk),
             "--moe-dispatch", args.moe_dispatch,
@@ -299,6 +303,8 @@ def main(argv=None):
             "--attn", args.attn,
         ]
         cmd += ["--raw"] if args.raw else ["--pickle"]
+        if args.n_layers is not None:
+            cmd += ["--n-layers", str(args.n_layers)]
         if args.skip_seqformer:
             cmd.append("--skip-seqformer")
         if args.skip_moe:

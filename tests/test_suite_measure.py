@@ -205,6 +205,31 @@ def test_phase_kernel_microverdicts_banks_incrementally(capsys):
     assert capsys.readouterr().out == ""
 
 
+def test_apply_config_n_layers_sentinel():
+    """--n-layers default is a None sentinel so the confirm-first
+    tunneled-TPU path can tell 'unset' (downshift to live-window depth)
+    from an explicit operator choice (always wins, even at --config
+    small)."""
+    import argparse
+
+    from benchmarks.suite_device import apply_config
+
+    def ns(config, n_layers):
+        return argparse.Namespace(
+            config=config, n_layers=n_layers, seq_len=513, d_model=1024,
+            n_heads=8, seq_instances=2, width=640, height=480,
+        )
+
+    a = apply_config(ns("big", None))
+    assert a.n_layers == 8 and a.n_layers_explicit is False
+    a = apply_config(ns("small", None))
+    assert a.n_layers == 2 and a.n_layers_explicit is False
+    a = apply_config(ns("small", 4))
+    assert a.n_layers == 4 and a.n_layers_explicit is True
+    a = apply_config(ns("big", 2))
+    assert a.n_layers == 2 and a.n_layers_explicit is True
+
+
 def test_phase_put_strategy_emits_winner_and_loser(capsys):
     """The transfer-granularity probe ships winner AND loser; gated to
     tpu-tagged runs (on loopback it measures dispatch, not a strategy).
