@@ -57,7 +57,8 @@ def flash_block_size(seq_len):
     return next((b for b in (128, 64, 32) if seq_len % b == 0), seq_len)
 
 
-def _block_live(causal, qi, kj, block_q, block_kv, window=None):
+def _block_live(causal, qi, kj, block_q, block_kv, window=None,
+                q_offset=0):
     """False for blocks whose probabilities are exactly zero, so compute
     is skipped: strictly above the causal diagonal (roughly halves the
     FLOPs at long context), and — under a sliding ``window`` — strictly
@@ -65,18 +66,26 @@ def _block_live(causal, qi, kj, block_q, block_kv, window=None):
     grids are also *shrunk* (see ``_kv_window_steps``): ``kj``/``qi``
     may then be derived block indices that run past the array, and the
     two predicates below also correctly kill those overshoot steps (a
-    too-large ``kj`` fails the causal bound; a too-large ``qi`` fails
-    the window bound)."""
+    too-large ``kj`` fails the causal bound when ``q_offset == 0``; a
+    too-large ``qi`` fails the window bound) — EXCEPT a kv overshoot
+    under a nonzero ``q_offset``, where rows sit above every real
+    column and the caller's kernels add an explicit range guard.
+
+    ``q_offset`` (static) is the q rows' global position minus the kv
+    cols': the ring variants run this kernel on (my queries x an
+    EARLIER shard's KV), where the pair's offset is a static multiple
+    of the shard length."""
     if not causal:
         return True
-    live = kj * block_kv <= qi * block_q + (block_q - 1)
+    live = kj * block_kv <= qi * block_q + q_offset + (block_q - 1)
     if window is not None:
         # kv block's newest col must be within `window` of the q block's
         # oldest row: max_col >= min_row - (window - 1).  qi/kj are traced
         # program ids, so combine with logical_and, not `and`
         live = jnp.logical_and(
             live,
-            kj * block_kv + (block_kv - 1) >= qi * block_q - (window - 1),
+            kj * block_kv + (block_kv - 1)
+            >= qi * block_q + q_offset - (window - 1),
         )
     return live
 
@@ -93,11 +102,13 @@ def _kv_window_steps(num_kv, block_q, block_kv, window):
     return min(num_kv, (span - 2) // block_kv + 2)
 
 
-def _kv_base(i, block_q, block_kv, window):
+def _kv_base(i, block_q, block_kv, window, q_offset=0):
     """First KV block index visible to q block ``i`` (floor-clamped to
     0); traced — used in both the BlockSpec index maps and the kernels'
     liveness checks."""
-    return jnp.maximum(0, (i * block_q - (window - 1)) // block_kv)
+    return jnp.maximum(
+        0, (i * block_q + q_offset - (window - 1)) // block_kv
+    )
 
 
 def _q_window_steps(num_q, block_q, block_kv, window):
@@ -107,11 +118,12 @@ def _q_window_steps(num_q, block_q, block_kv, window):
     return min(num_q, (span - 2) // block_q + 2)
 
 
-def _q_base(j, block_q, block_kv, window):
+def _q_base(j, block_q, block_kv, window, q_offset=0):
     """First Q block index that can see KV block ``j`` (causal: rows
-    start at the block's own first column)."""
+    start at the block's own first column, shifted down by the pair's
+    static row/col offset)."""
     del window
-    return (j * block_kv) // block_q
+    return jnp.maximum(0, (j * block_kv - q_offset) // block_q)
 
 
 def _window_index_map(num_blocks, base_fn):
@@ -130,8 +142,8 @@ def _window_index_map(num_blocks, base_fn):
     return index_map
 
 
-def _mask(s, i, j, block_q, block_kv, window=None):
-    rows = i * block_q + jax.lax.broadcasted_iota(
+def _mask(s, i, j, block_q, block_kv, window=None, q_offset=0):
+    rows = q_offset + i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 0
     )
     cols = j * block_kv + jax.lax.broadcasted_iota(
@@ -144,19 +156,20 @@ def _mask(s, i, j, block_q, block_kv, window=None):
 
 
 def _scores(q_ref, k_ref, qi, kj, scale, causal, block_q, block_kv,
-            window=None):
+            window=None, q_offset=0):
     q = q_ref[0].astype(jnp.float32)
     k = k_ref[0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        s = _mask(s, qi, kj, block_q, block_kv, window)
+        s = _mask(s, qi, kj, block_q, block_kv, window, q_offset)
     return q, k, s
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-            scale, causal, block_q, block_kv, num_kv, window=None):
+            scale, causal, block_q, block_kv, num_kv, num_kv_total=None,
+            window=None, q_offset=0):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -168,12 +181,19 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     i = pl.program_id(1)
     # under a window the grid's kv axis is shrunk: step j maps to actual
     # kv block base(i) + j (overshoot steps are killed by _block_live)
-    kj = j if window is None else _kv_base(i, block_q, block_kv, window) + j
+    kj = j if window is None else _kv_base(
+        i, block_q, block_kv, window, q_offset
+    ) + j
+    live = _block_live(causal, i, kj, block_q, block_kv, window, q_offset)
+    if window is not None and q_offset:
+        # with rows offset above every real column the causal bound no
+        # longer kills a kv overshoot past the array — guard explicitly
+        live = jnp.logical_and(live, kj <= num_kv_total - 1)
 
-    @pl.when(_block_live(causal, i, kj, block_q, block_kv, window))
+    @pl.when(live)
     def _compute():
         _, _, s = _scores(q_ref, k_ref, i, kj, scale, causal, block_q,
-                          block_kv, window)
+                          block_kv, window, q_offset)
         v = v_ref[0].astype(jnp.float32)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
@@ -203,7 +223,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_acc, *, scale, causal, block_q, block_kv, num_kv,
-               window=None):
+               num_kv_total=None, window=None, q_offset=0):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -211,12 +231,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     i = pl.program_id(1)
-    kj = j if window is None else _kv_base(i, block_q, block_kv, window) + j
+    kj = j if window is None else _kv_base(
+        i, block_q, block_kv, window, q_offset
+    ) + j
+    live = _block_live(causal, i, kj, block_q, block_kv, window, q_offset)
+    if window is not None and q_offset:
+        live = jnp.logical_and(live, kj <= num_kv_total - 1)
 
-    @pl.when(_block_live(causal, i, kj, block_q, block_kv, window))
+    @pl.when(live)
     def _compute():
         _, k, s = _scores(q_ref, k_ref, i, kj, scale, causal, block_q,
-                          block_kv, window)
+                          block_kv, window, q_offset)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         p = jnp.exp(s - lse_ref[0].astype(jnp.float32))  # (bq,1) bcast
@@ -237,7 +262,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_kv,
-                num_q, num_q_total=None, window=None):
+                num_q, num_q_total=None, window=None, q_offset=0):
     i = pl.program_id(2)  # q-block index is INNERMOST in the dkv pass
 
     @pl.when(i == 0)
@@ -246,19 +271,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     j = pl.program_id(1)
-    qi = i if window is None else _q_base(j, block_q, block_kv, window) + i
-    live = _block_live(causal, qi, j, block_q, block_kv, window)
+    qi = i if window is None else _q_base(
+        j, block_q, block_kv, window, q_offset
+    ) + i
+    live = _block_live(causal, qi, j, block_q, block_kv, window, q_offset)
     if window is not None:
-        # unlike KV overshoot (killed by the causal bound), a derived qi
-        # past the last real q block still passes both predicates when
-        # the window span runs off the end of the sequence — and would
-        # double-count the clamped block under a phantom-row mask
+        # unlike KV overshoot (killed by the causal bound at zero
+        # offset), a derived qi past the last real q block still passes
+        # both predicates when the window span runs off the end of the
+        # sequence — and would double-count the clamped block under a
+        # phantom-row mask
         live = jnp.logical_and(live, qi <= num_q_total - 1)
 
     @pl.when(live)
     def _compute():
         q, _, s = _scores(q_ref, k_ref, qi, j, scale, causal, block_q,
-                          block_kv, window)
+                          block_kv, window, q_offset)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         p = jnp.exp(s - lse_ref[0].astype(jnp.float32))  # (bq,1) bcast
@@ -312,26 +340,32 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _check_blocks(t, block_q, block_kv):
-    if t % block_q or t % block_kv:
+def _check_blocks(t, block, name):
+    if t % block:
         raise ValueError(
-            f"sequence length {t} must divide block_q={block_q} and "
-            f"block_kv={block_kv} (pad upstream or pick smaller blocks)"
+            f"sequence length {t} must divide {name}={block} "
+            "(pad upstream or pick smaller blocks)"
         )
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
-                    out_dtype=None, window=None):
+                    out_dtype=None, window=None, q_offset=0):
     """Returns (out (B,T,H,D), flat residuals (qf,kf,vf,of,lse)).
 
     ``out_dtype`` overrides the output dtype (default: q's) — ring_flash
     requests f32 so its cross-block combination accumulates unrounded
-    partials (the kernel's internal accumulator is f32 regardless)."""
+    partials (the kernel's internal accumulator is f32 regardless).
+    ``q_offset`` (static): global position of q row 0 minus kv col 0 —
+    the windowed ring variant runs this on (my queries x an earlier
+    shard's KV) where the offset is a static shard multiple; k/v may
+    then have a different sequence length than q."""
     b, t, h, d = q.shape
-    _check_blocks(t, block_q, block_kv)
+    tk = k.shape[1]
+    _check_blocks(t, block_q, "block_q")
+    _check_blocks(tk, block_kv, "block_kv")
     qf, kf, vf = _flat(q), _flat(k), _flat(v)
     num_q = t // block_q
-    num_kv = t // block_kv
+    num_kv = tk // block_kv
 
     if window is None:
         kv_steps = num_kv
@@ -340,12 +374,14 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
         # shrunk grid: O(window) kv steps per q block
         kv_steps = _kv_window_steps(num_kv, block_q, block_kv, window)
         kv_im = _window_index_map(
-            num_kv, lambda i: _kv_base(i, block_q, block_kv, window)
+            num_kv,
+            lambda i: _kv_base(i, block_q, block_kv, window, q_offset),
         )
 
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, num_kv=kv_steps, window=window,
+        block_kv=block_kv, num_kv=kv_steps, num_kv_total=num_kv,
+        window=window, q_offset=q_offset,
     )
     of, lse = pl.pallas_call(
         kernel,
@@ -419,7 +455,8 @@ def _fwd(q, k, v, causal, scale, block_q, block_kv, interpret, window):
 
 
 def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
-             block_kv, interpret, out_dtype=None, window=None):
+             block_kv, interpret, out_dtype=None, window=None,
+             q_offset=0):
     """dQ for one (Tq, Tk) pair of flat arrays — used over the full
     sequence by :func:`flash_attention`'s vjp and per ring-block pair by
     :func:`blendjax.parallel.ring_attention.ring_flash_attention` (which
@@ -434,7 +471,8 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     else:
         kv_steps = _kv_window_steps(num_kv, block_q, block_kv, window)
         kv_im = _window_index_map(
-            num_kv, lambda i: _kv_base(i, block_q, block_kv, window)
+            num_kv,
+            lambda i: _kv_base(i, block_q, block_kv, window, q_offset),
         )
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec_j = pl.BlockSpec((1, block_kv, d), kv_im)
@@ -442,7 +480,8 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     return pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, block_q=block_q,
-            block_kv=block_kv, num_kv=kv_steps, window=window,
+            block_kv=block_kv, num_kv=kv_steps, num_kv_total=num_kv,
+            window=window, q_offset=q_offset,
         ),
         grid=(bh, num_q, kv_steps),
         in_specs=[q_spec_i, kv_spec_j, kv_spec_j, q_spec_i, row_spec_i,
@@ -455,7 +494,8 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
 
 
 def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
-              block_kv, interpret, out_dtype=None, window=None):
+              block_kv, interpret, out_dtype=None, window=None,
+              q_offset=0):
     """dK/dV for one (Tq, Tk) pair: kv blocks in the MIDDLE grid dim, q
     blocks INNERMOST so the accumulators carry across q steps."""
     bh, tq, d = qf.shape
@@ -467,7 +507,8 @@ def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     else:
         q_steps = _q_window_steps(num_q, block_q, block_kv, window)
         q_im = _window_index_map(
-            num_q, lambda j: _q_base(j, block_q, block_kv, window)
+            num_q,
+            lambda j: _q_base(j, block_q, block_kv, window, q_offset),
         )
     q_spec_inner = pl.BlockSpec((1, block_q, d), q_im)
     kv_spec_mid = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
@@ -476,7 +517,7 @@ def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
             block_kv=block_kv, num_q=q_steps, num_q_total=num_q,
-            window=window,
+            window=window, q_offset=q_offset,
         ),
         grid=(bh, num_kv, q_steps),
         in_specs=[q_spec_inner, kv_spec_mid, kv_spec_mid, q_spec_inner,

@@ -105,13 +105,33 @@ def full_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None):
+def _window_ring_deltas(window, s_loc, n):
+    """How many earlier neighbor shards a sliding window reaches: shard
+    me needs shard me-d iff the newest key there, position
+    ``(me-d+1)*s_loc - 1``, is within ``window`` of me's oldest query
+    ``me*s_loc`` — i.e. ``(d-1)*s_loc + 2 <= window``.  This is the
+    windowed ring's whole point: compute AND ring traffic become
+    O(window), not O(S) — a ring step rotates only ``dmax`` times."""
+    if window < 2:
+        return 0
+    return min(n - 1, (window - 2) // s_loc + 1)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   vary_axes=None, window=None):
     """Exact blockwise attention over a ring of sequence shards.
 
     Call inside ``shard_map``: q/k/v are the *local* shards
     (B, S/n, H, D) of arrays sharded ``P(None, axis_name, None, None)``.
     Returns the local shard of the attention output.
+
+    ``window=W`` (causal only) is sliding-window attention: the ring
+    then rotates BACKWARD and stops after ``_window_ring_deltas`` steps
+    — shards older than the window are never fetched, so ring traffic
+    scales with the window, not the sequence.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -127,6 +147,8 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None)
         if causal:
             kpos = blk * s_loc + jnp.arange(s_loc)
             mask = kpos[None, :] <= qpos[:, None]
+            if window is not None:
+                mask &= kpos[None, :] > qpos[:, None] - window
             scores = jnp.where(mask[None, None], scores, _NEG)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         p = jnp.exp(scores - m_new[..., None])
@@ -134,13 +156,6 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None)
         l = l * corr + p.sum(axis=-1)
         pv = jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
         return o * corr[..., None] + pv, m_new, l
-
-    def body(carry, t):
-        o, m, l, kb, vb = carry
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        o, m, l = accumulate(o, m, l, kb, vb, (me + t) % n)
-        return (o, m, l, kb, vb), None
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
     m0 = jnp.full((b, h, s_loc), _NEG, jnp.float32)
@@ -150,9 +165,40 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, vary_axes=None)
     # over every axis the inputs vary over (seq + optional batch axis).
     axes = tuple(vary_axes) if vary_axes else (axis_name,)
     o0, m0, l0 = (_pvary(x, axes) for x in (o0, m0, l0))
-    # Own block first (no rotation), then n-1 rotate-and-accumulate steps.
+    # Own block first (no rotation): every query sees itself (window >= 1),
+    # so m is finite before any possibly-all-masked rotation pair — an
+    # all-masked pair then contributes exp(_NEG - m) = 0, not garbage.
     o, m, l = accumulate(o0, m0, l0, k, v, me)
-    (o, _, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(1, n))
+
+    if window is None:
+        def body(carry, t):
+            o, m, l, kb, vb = carry
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+            o, m, l = accumulate(o, m, l, kb, vb, (me + t) % n)
+            return (o, m, l, kb, vb), None
+
+        (o, _, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(1, n))
+    else:
+        # windowed: rotate BACKWARD (earlier shards) and stop once the
+        # window is exhausted — t rotations put shard (me - t) % n here
+        perm_back = [(j, (j + 1) % n) for j in range(n)]
+        dmax = _window_ring_deltas(window, s_loc, n)
+
+        def body(carry, t):
+            o, m, l, kb, vb = carry
+            kb = lax.ppermute(kb, axis_name, perm_back)
+            vb = lax.ppermute(vb, axis_name, perm_back)
+            # (me - t) % n wraps to a FUTURE shard on devices me < t;
+            # its columns fail the causal mask, so the all-masked pair
+            # is a (wasted but exact) no-op on those devices
+            o, m, l = accumulate(o, m, l, kb, vb, (me - t) % n)
+            return (o, m, l, kb, vb), None
+
+        if dmax > 0:
+            (o, _, l, _, _), _ = lax.scan(
+                body, (o, m, l, k, v), jnp.arange(1, dmax + 1)
+            )
     out = o / l[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
@@ -176,9 +222,9 @@ def _lse_combine(o, lse, o_b, lse_b):
     return o * w_old + o_b * w_new, lse_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
-                         interpret=False, vary_axes=None):
+                         interpret=False, vary_axes=None, window=None):
     """:func:`ring_attention` with the fused Pallas flash kernel per
     block pair — O(S/n) memory per device AND no (S/n, S/n) score matrix
     materialized within a block.
@@ -193,16 +239,32 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
     custom VJP at the ring level: the backward rotates K/V *and* their
     gradient accumulators around the ring, running the fused dQ and
     dK/dV kernels per visible pair, so no pass materializes scores.
+
+    ``window=W`` (causal only) is sliding-window attention: the ring
+    rotates BACKWARD and stops after ``_window_ring_deltas(W, S/n, n)``
+    steps, each pair running the windowed kernel with a STATIC
+    ``q_offset`` (the rotation count is a Python loop index, so every
+    pair's row/col offset is known at trace time) — per-device compute,
+    HBM traffic, AND ring collectives all scale O(W) instead of O(S).
+    A window wider than the sequence degrades gracefully to the full
+    causal ring.
     """
     out, _ = _ring_flash_fwd(
-        q, k, v, axis_name, causal, scale, interpret, vary_axes
+        q, k, v, axis_name, causal, scale, interpret, vary_axes, window
     )
     return out
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
-                    vary_axes):
+                    vary_axes, window=None):
     from blendjax.ops.flash_attention import _default_scale, _flash_fwd_impl
+
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        return _ring_flash_fwd_windowed(
+            q, k, v, axis_name, scale, interpret, vary_axes, window
+        )
 
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
@@ -257,8 +319,127 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
     return out, (q, k, v, out, lse)
 
 
+def _ring_flash_fwd_windowed(q, k, v, axis_name, scale, interpret,
+                             vary_axes, window):
+    """Sliding-window ring + flash forward.
+
+    Rotation ``t`` (a PYTHON loop index — ``dmax`` is static) holds
+    shard ``(me - t) % n``: an earlier shard at static offset
+    ``t * s_loc`` for devices ``me >= t``, a wrapped future shard
+    otherwise.  The pair kernel runs with ``causal=True, window,
+    q_offset=t*s_loc`` — at that offset the causal mask is all-true and
+    the window mask prunes — under ``lax.cond`` so wrapped devices skip
+    the compute entirely (the ppermute itself is unconditional: it is a
+    collective).  Rows beyond a pair's window emit ``lse = -1e30`` and
+    weigh zero in the logsumexp combine."""
+    from blendjax.ops.flash_attention import _default_scale, _flash_fwd_impl
+
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale_v = _default_scale(scale, d)
+    blk = _ring_blk(s_loc)
+    perm_back = [(j, (j + 1) % n) for j in range(n)]
+    dmax = _window_ring_deltas(window, s_loc, n)
+
+    def pair(kb, vb, q_offset):
+        o_b, res = _flash_fwd_impl(
+            q, kb, vb, True, scale_v, blk, blk, interpret,
+            out_dtype=jnp.float32, window=window, q_offset=q_offset,
+        )
+        return o_b, res[4].reshape(b, h, s_loc)
+
+    # own shard: every query sees itself, so (o, lse) start finite
+    o, lse = pair(k, v, 0)
+    kb, vb = k, v
+    for t in range(1, dmax + 1):
+        kb = lax.ppermute(kb, axis_name, perm_back)
+        vb = lax.ppermute(vb, axis_name, perm_back)
+        o, lse = lax.cond(
+            me >= t,
+            lambda kb=kb, vb=vb, o=o, lse=lse, t=t: _lse_combine(
+                o, lse, *pair(kb, vb, t * s_loc)
+            ),
+            lambda o=o, lse=lse: (o, lse),
+        )
+    out = o.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_windowed(axis_name, scale, interpret, vary_axes,
+                             window, res, g):
+    """Backward of the windowed ring: dK/dV accumulators TRAVEL with
+    their shard for the ``dmax`` rotations (each visiting device adds
+    its pair's contribution), then a single ``ppermute`` jumps every
+    accumulator straight home — ``dmax + 1`` collectives per gradient
+    array instead of the full ring's ``n``."""
+    from blendjax.ops.flash_attention import (
+        _default_scale,
+        _dkv_pass,
+        _dq_pass,
+        _flat,
+        _unflat,
+    )
+
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale_v = _default_scale(scale, d)
+    blk = _ring_blk(s_loc)
+    perm_back = [(j, (j + 1) % n) for j in range(n)]
+    dmax = _window_ring_deltas(window, s_loc, n)
+
+    qf, dof, of = _flat(q), _flat(g), _flat(out)
+    delta = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(
+        -1, keepdims=True
+    )
+    lse_f = lse.reshape(b * h, s_loc, 1)
+
+    def pair_grads(kbf, vbf, q_offset):
+        dq_c = _dq_pass(qf, kbf, vbf, dof, lse_f, delta, True, scale_v,
+                        blk, blk, interpret, out_dtype=jnp.float32,
+                        window=window, q_offset=q_offset)
+        dk_c, dv_c = _dkv_pass(qf, kbf, vbf, dof, lse_f, delta, True,
+                               scale_v, blk, blk, interpret,
+                               out_dtype=jnp.float32, window=window,
+                               q_offset=q_offset)
+        return dq_c, dk_c, dv_c
+
+    # own pair seeds both the local dQ and the traveling dK/dV
+    dq, dk_t, dv_t = pair_grads(_flat(k), _flat(v), 0)
+    kbf, vbf = _flat(k), _flat(v)
+    for t in range(1, dmax + 1):
+        kbf = lax.ppermute(kbf, axis_name, perm_back)
+        vbf = lax.ppermute(vbf, axis_name, perm_back)
+        dk_t = lax.ppermute(dk_t, axis_name, perm_back)
+        dv_t = lax.ppermute(dv_t, axis_name, perm_back)
+        dq, dk_t, dv_t = lax.cond(
+            me >= t,
+            lambda kbf=kbf, vbf=vbf, dq=dq, dk_t=dk_t, dv_t=dv_t, t=t: (
+                lambda c: (dq + c[0], dk_t + c[1], dv_t + c[2])
+            )(pair_grads(kbf, vbf, t * s_loc)),
+            lambda dq=dq, dk_t=dk_t, dv_t=dv_t: (dq, dk_t, dv_t),
+        )
+    if dmax > 0:
+        # one jump home: the accumulator traveling with shard
+        # (me - dmax) % n returns to its owner
+        perm_home = [(j, (j - dmax) % n) for j in range(n)]
+        dk_t = lax.ppermute(dk_t, axis_name, perm_home)
+        dv_t = lax.ppermute(dv_t, axis_name, perm_home)
+    return (
+        _unflat(dq, b, h).astype(q.dtype),
+        _unflat(dk_t, b, h).astype(k.dtype),
+        _unflat(dv_t, b, h).astype(v.dtype),
+    )
+
+
 def _ring_flash_bwd(axis_name, causal, scale, interpret, vary_axes,
-                    res, g):
+                    window, res, g):
+    if window is not None:
+        return _ring_flash_bwd_windowed(
+            axis_name, scale, interpret, vary_axes, window, res, g
+        )
     from blendjax.ops.flash_attention import (
         _default_scale,
         _dkv_pass,
@@ -570,7 +751,7 @@ zigzag_flash_attention.defvjp(_zz_fwd, _zz_bwd)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
-                      inner_attn=None):
+                      inner_attn=None, window=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence-parallel attention.
 
     Call inside ``shard_map`` with local shards (B, S/n, H, D); requires
@@ -582,20 +763,29 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     kernel (:func:`blendjax.ops.flash_attention`), since after the
     all-to-all each device holds the COMPLETE sequence for its head
     group and pays the O(S^2) score matrix right here.
+
+    ``window`` passes straight to the inner attention (after the
+    all-to-all each head group sees the full sequence, so sliding-window
+    masking needs no cross-shard machinery here).
     """
     inner = inner_attn or full_attention
+    kwargs = dict(causal=causal, scale=scale)
+    if window is not None:
+        # only passed when set, so inner_attn closures predating the
+        # window option keep working
+        kwargs["window"] = window
     # (B, S/n, H, D) -> (B, S, H/n, D)
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = inner(qh, kh, vh, causal=causal, scale=scale)
+    out = inner(qh, kh, vh, **kwargs)
     # back to (B, S/n, H, D)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 def make_ring_attention(
     mesh, seq_axis="seq", causal=False, impl="ring", batch_axis=None,
-    head_axis=None, inner_attn=None, flash_interpret=None,
+    head_axis=None, inner_attn=None, flash_interpret=None, window=None,
 ):
     """Wrap :func:`ring_attention` / :func:`ring_flash_attention` /
     :func:`ulysses_attention` for global arrays sharded
@@ -611,12 +801,23 @@ def make_ring_attention(
     (``head_axis='model'``): each device then ring-rotates K/V for its
     head block, so sequence and tensor parallelism stack.  Ulysses
     repurposes the head axis for its all-to-all and cannot also shard it.
+
+    ``window=W`` (causal only) is sliding-window attention.  The ring
+    variants then rotate only ``ceil`` of window/shard steps — compute
+    and ring traffic O(W) — and ulysses passes the window to its inner
+    attention.  ``zigzag_flash`` rejects it: zigzag balances the FULL
+    causal ring's triangular load, while a windowed ring's per-device
+    work is already ~uniform (diagonal + the same few neighbor shards
+    everywhere), so plain ``ring_flash`` is the windowed configuration.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     spec = P(batch_axis, seq_axis, head_axis, None)
     vary = tuple(a for a in (batch_axis, seq_axis, head_axis) if a is not None)
     if impl == "ring":
         inner = functools.partial(
-            ring_attention, axis_name=seq_axis, causal=causal, vary_axes=vary
+            ring_attention, axis_name=seq_axis, causal=causal,
+            vary_axes=vary, window=window,
         )
     elif impl == "ring_flash":
         if flash_interpret is None:
@@ -626,13 +827,18 @@ def make_ring_attention(
                   _interp=flash_interpret):
             # positional call: custom_vjp rejects nondiff args by keyword
             return ring_flash_attention(
-                q, k, v, _axis, causal, None, _interp, _vary
+                q, k, v, _axis, causal, None, _interp, _vary, window
             )
     elif impl == "zigzag_flash":
         if not causal:
             raise ValueError(
                 "zigzag_flash balances the CAUSAL ring's load; a "
                 "non-causal ring has no imbalance — use ring_flash"
+            )
+        if window is not None:
+            raise ValueError(
+                "zigzag_flash + window is pointless: the windowed ring "
+                "is already load-balanced — use impl='ring_flash'"
             )
         if flash_interpret is None:
             flash_interpret = jax.default_backend() != "tpu"
@@ -647,7 +853,8 @@ def make_ring_attention(
             raise ValueError("ulysses uses the head dim for its all-to-all; "
                              "head_axis sharding is ring-only")
         inner = functools.partial(ulysses_attention, axis_name=seq_axis,
-                                  causal=causal, inner_attn=inner_attn)
+                                  causal=causal, inner_attn=inner_attn,
+                                  window=window)
     else:
         raise ValueError(f"unknown impl {impl!r} (want 'ring', "
                          "'ring_flash', 'zigzag_flash' or 'ulysses')")

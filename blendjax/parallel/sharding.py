@@ -142,6 +142,7 @@ def make_seqformer_train_step(
     moe_aux_weight=0.0,
     compute_dtype=None,
     flash_interpret=None,
+    attn_window=None,
 ):
     """4-way-parallel training step for the SeqFormer world-model.
 
@@ -159,6 +160,10 @@ def make_seqformer_train_step(
     ``moe_impl='topk'`` switches the expert layer from the dense mixture
     to routed expert parallelism (top-k gating + capacity,
     :mod:`blendjax.models.moe`) with an optional load-balance aux loss.
+    ``attn_window=W`` enables sliding-window attention through whichever
+    scheme is selected (ring variants then rotate only the shards the
+    window reaches — compute and ring traffic O(W); zigzag rejects it,
+    the windowed ring is already balanced).
 
     Returns ``(init_sharded, step, batch_sharding)``; device_put batches
     with ``batch_sharding`` (leading dims sharded data x seq).
@@ -187,11 +192,11 @@ def make_seqformer_train_step(
         else:
             interpret = flash_interpret
 
-        def inner_attn(q, k, v, causal=False, scale=None):
+        def inner_attn(q, k, v, causal=False, scale=None, window=None):
             # one tile-selection policy for the ulysses and ring paths
             blk = flash_block_size(q.shape[1])
             return flash_attention(
-                q, k, v, causal, scale, blk, blk, interpret
+                q, k, v, causal, scale, blk, blk, interpret, window
             )
     attn = make_ring_attention(
         mesh,
@@ -206,6 +211,7 @@ def make_seqformer_train_step(
         flash_interpret=(flash_interpret
                          if attn_impl in ("ring_flash", "zigzag_flash")
                          else None),
+        window=attn_window,
     )
     rules = seqformer_rules(model_axis, expert_axis)
     loss_kwargs = dict(

@@ -290,6 +290,37 @@ def test_ring_flash_sharded_step_lowers_for_tpu():
     assert len(exp.mlir_module_serialized) > 0
 
 
+def test_windowed_ring_flash_sharded_step_lowers_for_tpu():
+    """Sliding-window ring_flash: per-pair windowed kernels at static
+    q_offsets, early-stopped rotation, single accumulator jump home in
+    the backward — the full sharded train step must export COMPILED for
+    TPU with vma checking (the long-context windowed configuration)."""
+    import numpy as np
+    import optax
+
+    from blendjax.models import seqformer
+    from blendjax.parallel import make_mesh, make_seqformer_train_step
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params = seqformer.init(
+        jax.random.PRNGKey(1), obs_dim=6, d_model=32, n_heads=4,
+        n_layers=1, max_len=32,
+    )
+    init_sf, step, batch_sharding = make_seqformer_train_step(
+        optax.adam(1e-3), mesh, attn_impl="ring_flash",
+        flash_interpret=False, attn_window=20,
+    )
+    state = init_sf(params)
+    batch = jax.device_put(
+        seqformer.make_episode_batch(
+            np.random.default_rng(0).random((4, 33, 6), np.float32)
+        ),
+        batch_sharding,
+    )
+    exp = jax.export.export(step, platforms=["tpu"])(state, batch)
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_flash_attention_32_tile_lowers_for_tpu():
     """The bench gate now admits any 32-multiple length; sub-128 tiles
     (lse blocks (32, 1), scratch (32, 128)) must lower too — a Mosaic
