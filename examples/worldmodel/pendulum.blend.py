@@ -104,7 +104,14 @@ def main():
     anim.pre_frame.add(pre_frame)
     anim.post_frame.add(post_frame)
     anim.post_animation.add(post_animation)
-    anim.play(frame_range=(0, T + 1), num_episodes=-1)
+    # --background has no window-manager player: use the blocking
+    # frame_set loop there (same handler sequence, synchronous); the
+    # launcher's default IS background mode, so this is the normal path
+    anim.play(
+        frame_range=(0, T + 1), num_episodes=-1,
+        use_animation=not getattr(bpy.app, "background", False),
+        use_offline_render=False,
+    )
 
 
 main()

@@ -50,7 +50,7 @@ def episode_transform(batch):
     return {"episode": batch["obs_seq"].astype(np.float16)}
 
 
-def make_attn(name, seq_len):
+def make_attn(name, seq_len, window=None):
     """Single-device attention override for ``--attn``.
 
     Parallel scheme names are rejected here — silently running the
@@ -58,7 +58,14 @@ def make_attn(name, seq_len):
     any comparison the user thinks they ran (use ``--mesh`` for those).
     """
     if name == "full":
-        return None
+        if window is None:
+            return None
+        from blendjax.parallel.ring_attention import full_attention
+
+        def windowed_full(q, k, v):
+            return full_attention(q, k, v, causal=True, window=window)
+
+        return windowed_full
     if name != "flash":
         raise ValueError(
             f"--attn {name} is a parallel scheme; pass --mesh dp,sp,tp "
@@ -72,7 +79,7 @@ def make_attn(name, seq_len):
     blk = flash_block_size(seq_len)  # T must divide the flash tile
     return make_flash_attention(
         causal=True, block_q=blk, block_kv=blk,
-        interpret=jax.default_backend() != "tpu",
+        interpret=jax.default_backend() != "tpu", window=window,
     )
 
 
@@ -110,7 +117,7 @@ def sharded_transform(batch):
 
 
 def make_sharded_trainer(mesh_shape, attn_impl, d_model=128, n_heads=4,
-                         n_layers=2):
+                         n_layers=2, window=None):
     """(state, step, batch_sharding) for dp x sp x tp training.
 
     Built BEFORE the stream so JaxStream can place batches directly on
@@ -126,7 +133,7 @@ def make_sharded_trainer(mesh_shape, attn_impl, d_model=128, n_heads=4,
         n_heads=n_heads, n_layers=n_layers, max_len=T,
     )
     init_sharded, step, batch_sharding = make_seqformer_train_step(
-        optax.adam(3e-4), mesh, attn_impl=attn_impl
+        optax.adam(3e-4), mesh, attn_impl=attn_impl, attn_window=window
     )
     return init_sharded(params), step, batch_sharding
 
@@ -151,6 +158,10 @@ def main():
                     choices=list(SINGLE_ATTN) + list(PARALLEL_ATTN),
                     help="default: full (single device) / ring_flash "
                          "(--mesh)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention width (causal); on "
+                         "the ring schemes the ring then rotates only "
+                         "the shards the window reaches")
     ap.add_argument("--mesh", default=None,
                     help="dp,sp,tp device counts; enables the sharded "
                          "path (attn must then be one of "
@@ -165,14 +176,14 @@ def main():
                      f"got {attn!r}")
         mesh_shape = tuple(int(x) for x in args.mesh.split(","))
         state, step, batch_sharding = make_sharded_trainer(
-            mesh_shape, attn
+            mesh_shape, attn, window=args.window
         )
         stream_kwargs = dict(
             transform=sharded_transform, sharding=batch_sharding
         )
     else:
         attn = args.attn or "full"
-        attn_fn = make_attn(attn, T)  # rejects parallel names
+        attn_fn = make_attn(attn, T, window=args.window)  # rejects parallel names
         stream_kwargs = dict(transform=episode_transform)
 
     launcher = btt.BlenderLauncher(

@@ -42,7 +42,10 @@ def main():
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         import fake_bpy
 
-        fake_bpy.install()
+        fake = fake_bpy.install()
+        # real Blender sets bpy.app.background under --background;
+        # producers pick the blocking animation loop off it
+        fake.app.background = "--background" in argv
     try:
         runpy.run_path(script, run_name="__main__")
     except SystemExit as e:

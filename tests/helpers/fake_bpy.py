@@ -247,6 +247,30 @@ class FakeCameraObject:
         ])
 
 
+class FakeObject:
+    """Generic posed object (empties, primitive meshes): location +
+    XYZ-euler rotation, optional ``parent`` composed into
+    ``matrix_world`` the way Blender's depsgraph does for simple
+    parenting (no inverse-parent correction — objects here are created
+    at the origin before parenting, matching the procedural-producer
+    usage this fake serves)."""
+
+    def __init__(self, location=(0.0, 0.0, 0.0)):
+        self.location = Vector(location)
+        self.rotation_euler = (0.0, 0.0, 0.0)
+        self.parent = None
+        self.name = ""
+
+    @property
+    def matrix_world(self):
+        m = Matrix.from_rt(
+            _rotmat_from_euler_xyz(*self.rotation_euler), self.location
+        )
+        if self.parent is not None:
+            return self.parent.matrix_world @ m
+        return m
+
+
 class FakeMeshObject:
     """Mesh object with explicit local-space vertices; evaluated_get
     returns itself (depsgraph evaluation is an identity here)."""
@@ -420,11 +444,27 @@ class _Ops:
         self.screen = types.SimpleNamespace(
             animation_play=self._play, animation_cancel=self._cancel
         )
-        # scene-authoring ops used by procedural producer scripts
+        # scene-authoring ops used by procedural producer scripts; each
+        # add-op appends a posed FakeObject and makes it active, like
+        # Blender's operators
         self.object = types.SimpleNamespace(
             select_all=lambda action=None: None,
             delete=lambda use_global=False: self._bpy.data.objects.clear(),
+            empty_add=lambda location=(0.0, 0.0, 0.0), **kw: self._add(
+                FakeObject(location)
+            ),
         )
+        self.mesh = types.SimpleNamespace(
+            primitive_uv_sphere_add=lambda radius=1.0,
+            location=(0.0, 0.0, 0.0), **kw: self._add(FakeObject(location)),
+            primitive_cube_add=lambda size=2.0,
+            location=(0.0, 0.0, 0.0), **kw: self._add(FakeObject(location)),
+        )
+
+    def _add(self, obj):
+        self._bpy.data.objects.append(obj)
+        self._bpy.context.active_object = obj
+        return {"FINISHED"}
 
     def _play(self):
         self._bpy._animation_running = True
@@ -434,7 +474,11 @@ class _Ops:
 
 
 class _PropCollection(list):
-    """Stands in for ``bpy.types.bpy_prop_collection`` (scene_stats)."""
+    """Stands in for ``bpy.types.bpy_prop_collection`` (scene_stats,
+    ``bpy.data.objects``)."""
+
+    def remove(self, obj, do_unlink=False):
+        list.remove(self, obj)
 
 
 class FakeBpy(types.ModuleType):
@@ -442,7 +486,12 @@ class FakeBpy(types.ModuleType):
 
     def __init__(self):
         super().__init__("bpy")
-        self.app = types.SimpleNamespace(handlers=_Handlers())
+        # background mirrors bpy.app.background; fake_blender sets it
+        # True when launched with --background (producers pick the
+        # blocking animation loop off it)
+        self.app = types.SimpleNamespace(
+            handlers=_Handlers(), background=False
+        )
         space = _SpaceData()
         scene = _Scene(self)
         self.context = types.SimpleNamespace(
@@ -451,6 +500,7 @@ class FakeBpy(types.ModuleType):
             space_data=space,
             view_layer=types.SimpleNamespace(name="ViewLayer"),
             evaluated_depsgraph_get=lambda: "<depsgraph>",
+            active_object=None,
         )
         self.types = types.SimpleNamespace(
             SpaceView3D=_SpaceView3DType,

@@ -15,7 +15,9 @@ few minutes warm:
 3. routed top-k (sort dispatch) is not slower than the dense mixture at
    e=8, k=2 (VERDICT r2's bar, never yet confirmed on chip);
 4. the wire canary measures a finite put bandwidth (the stream phases'
-   physical ceiling exists and is recordable).
+   physical ceiling exists and is recordable);
+5. sliding-window flash at W=T/4 is not slower than plain causal — the
+   O(T*W) grid shrink must be real on chip, not just masked FLOPs.
 
 The driver's ``bench.py`` captures the same facts inside the artifact;
 this pack is the judge-runnable/pytest-shaped version.
@@ -93,6 +95,47 @@ def test_flash_compiled_not_slower_than_full_attention():
     assert ratio <= 1.05, (
         f"compiled flash step {flash['step_s']*1e3:.2f}ms slower than "
         f"full attention {full['step_s']*1e3:.2f}ms (ratio {ratio:.3f})"
+    )
+
+
+def test_windowed_flash_not_slower_than_plain_causal():
+    """Sliding-window flash at W=T/4: the shrunk O(T*W) grids must beat
+    (or at worst match) the plain causal kernel on chip — if the grid
+    shrink were broken (full grid + masking only), the ratio would sit
+    near 1 instead of well under it."""
+    from blendjax.ops.flash_attention import flash_attention
+
+    B, T, H, D = 2, 2048, 4, 128
+    W = T // 4
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+               for kk in ks)
+    budget = Budget(300, who="tpu-acceptance")
+
+    def timed(window):
+        def step(state, _):
+            q, k, v = state
+            l, (gq, gk, gv) = jax.value_and_grad(
+                lambda q, k, v: (flash_attention(
+                    q, k, v, True, None, 128, 128, False, window
+                ).astype(jnp.float32) ** 2).mean(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            lr = jnp.asarray(1e-3, q.dtype)
+            return (q - lr * gq, k - lr * gk, v - lr * gv), l
+
+        stats, _ = measure_step_time(
+            jax.jit(step), (q, k, v), None, budget, windows=2
+        )
+        return stats["step_s"]
+
+    windowed = timed(W)
+    plain = timed(None)
+    ratio = windowed / plain
+    assert ratio <= 1.05, (
+        f"windowed flash step {windowed*1e3:.2f}ms not faster than plain "
+        f"causal {plain*1e3:.2f}ms (ratio {ratio:.3f}) — grid shrink "
+        "not effective on chip"
     )
 
 
