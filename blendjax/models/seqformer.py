@@ -81,14 +81,27 @@ def init(
     d_ff=None,
     n_experts=0,
     max_len=1024,
+    n_kv_heads=None,
 ):
     """Initialize SeqFormer params.
 
     ``n_experts=0`` gives a dense MLP; ``n_experts>0`` the MoE variant.
+    ``n_kv_heads < n_heads`` is grouped-query attention: k/v project to
+    fewer heads (smaller params + KV bandwidth).  Grouped shapes are
+    handled by ``full_attention`` (broadcast) and the flash kernel
+    (KV-head-mapped BlockSpecs, group-summed dK/dV) behind the
+    ``attn_fn`` seam; the ring sequence-parallel schemes reject them
+    (their ring-level VJPs rotate per-q-head accumulators) — use
+    ulysses or repeat kv heads upstream there.
     """
     d_ff = d_ff or 4 * d_model
     if d_model % n_heads:
         raise ValueError(f"d_model {d_model} not divisible by n_heads {n_heads}")
+    n_kv_heads = n_kv_heads or n_heads
+    if n_heads % n_kv_heads:
+        raise ValueError(
+            f"n_heads {n_heads} not divisible by n_kv_heads {n_kv_heads}"
+        )
     dh = d_model // n_heads
     keys = jax.random.split(key, 3 + n_layers)
     params = {
@@ -109,10 +122,12 @@ def init(
             "ln1": _ln_init(d_model),
             "wq": {"w": jax.random.normal(kq, (d_model, n_heads, dh)) * scale,
                    "b": jnp.zeros((n_heads, dh))},
-            "wk": {"w": jax.random.normal(kk, (d_model, n_heads, dh)) * scale,
-                   "b": jnp.zeros((n_heads, dh))},
-            "wv": {"w": jax.random.normal(kv, (d_model, n_heads, dh)) * scale,
-                   "b": jnp.zeros((n_heads, dh))},
+            "wk": {"w": jax.random.normal(kk, (d_model, n_kv_heads, dh))
+                   * scale,
+                   "b": jnp.zeros((n_kv_heads, dh))},
+            "wv": {"w": jax.random.normal(kv, (d_model, n_kv_heads, dh))
+                   * scale,
+                   "b": jnp.zeros((n_kv_heads, dh))},
             "wo": {"w": jax.random.normal(ko, (n_heads, dh, d_model)) * scale,
                    "b": jnp.zeros((d_model,))},
             "ln2": _ln_init(d_model),

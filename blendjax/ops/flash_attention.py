@@ -126,7 +126,7 @@ def _q_base(j, block_q, block_kv, window, q_offset=0):
     return jnp.maximum(0, (j * block_kv - q_offset) // block_q)
 
 
-def _window_index_map(num_blocks, base_fn):
+def _window_index_map(num_blocks, base_fn, head_map=None):
     """BlockSpec index map for a shrunk windowed grid axis: the inner
     grid step maps to block ``base(mid) + step``, clamped onto the last
     real block (overshoot steps' compute is killed by the kernels'
@@ -134,12 +134,45 @@ def _window_index_map(num_blocks, base_fn):
     pass's windowed axis has this shape — fwd/dQ run ``(bh, q, kv)``
     with the KV base driven by the q index, dK/dV runs ``(bh, kv, q)``
     with the Q base driven by the kv index — so one helper keeps the
-    three derivations from desynchronizing."""
+    three derivations from desynchronizing.  ``head_map`` remaps the
+    flat batch*head coordinate (GQA: several q heads share a kv head)."""
 
     def index_map(bh, mid, inner):
-        return (bh, jnp.minimum(base_fn(mid) + inner, num_blocks - 1), 0)
+        b = bh if head_map is None else head_map(bh)
+        return (b, jnp.minimum(base_fn(mid) + inner, num_blocks - 1), 0)
 
     return index_map
+
+
+def _kv_head_map(h_q, h_kv):
+    """Flat ``b*h`` index of the KV head serving flat q-head ``bh`` —
+    grouped-query attention's whole mechanism at the BlockSpec level:
+    ``h_q // h_kv`` consecutive q heads read the same KV block, so the
+    kernel bodies never know GQA exists.  Identity (None) when the head
+    counts match."""
+    if h_q == h_kv:
+        return None
+    g = h_q // h_kv
+    return lambda bh: (bh // h_q) * h_kv + (bh % h_q) // g
+
+
+def _kv_axis(num_kv, block_q, block_kv, window, q_offset, khm):
+    """(steps, index map) for the KV grid axis of the fwd and dQ passes
+    — the ONE place the windowed-shrink and GQA head-remap derivations
+    combine, so the two passes cannot desynchronize."""
+    if window is None:
+        if khm is None:
+            im = lambda bh, i, j: (bh, j, 0)
+        else:
+            im = lambda bh, i, j: (khm(bh), j, 0)
+        return num_kv, im
+    steps = _kv_window_steps(num_kv, block_q, block_kv, window)
+    im = _window_index_map(
+        num_kv,
+        lambda i: _kv_base(i, block_q, block_kv, window, q_offset),
+        head_map=khm,
+    )
+    return steps, im
 
 
 def _mask(s, i, j, block_q, block_kv, window=None, q_offset=0):
@@ -358,25 +391,30 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
     ``q_offset`` (static): global position of q row 0 minus kv col 0 —
     the windowed ring variant runs this on (my queries x an earlier
     shard's KV) where the offset is a static shard multiple; k/v may
-    then have a different sequence length than q."""
+    then have a different sequence length than q.
+
+    GQA: k/v may carry fewer heads than q (``h % h_kv == 0``); the KV
+    BlockSpecs then map each q head onto its group's shared KV head."""
     b, t, h, d = q.shape
-    tk = k.shape[1]
+    tk, h_kv = k.shape[1], k.shape[2]
     _check_blocks(t, block_q, "block_q")
     _check_blocks(tk, block_kv, "block_kv")
+    if h % h_kv:
+        raise ValueError(
+            f"q heads {h} must be a multiple of kv heads {h_kv} (GQA)"
+        )
+    if v.shape[2] != h_kv:
+        raise ValueError(
+            f"k has {h_kv} heads but v has {v.shape[2]} — the shared "
+            "KV head map would silently read wrong v blocks"
+        )
     qf, kf, vf = _flat(q), _flat(k), _flat(v)
     num_q = t // block_q
     num_kv = tk // block_kv
-
-    if window is None:
-        kv_steps = num_kv
-        kv_im = lambda bh, i, j: (bh, j, 0)
-    else:
-        # shrunk grid: O(window) kv steps per q block
-        kv_steps = _kv_window_steps(num_kv, block_q, block_kv, window)
-        kv_im = _window_index_map(
-            num_kv,
-            lambda i: _kv_base(i, block_q, block_kv, window, q_offset),
-        )
+    khm = _kv_head_map(h, h_kv)
+    kv_steps, kv_im = _kv_axis(
+        num_kv, block_q, block_kv, window, q_offset, khm
+    )
 
     kernel = functools.partial(
         _kernel, scale=scale, causal=causal, block_q=block_q,
@@ -429,6 +467,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     ``T`` must divide by both block sizes (pick blocks accordingly or pad
     upstream).  ``interpret=True`` runs on CPU (CI parity tests).
 
+    GQA/MQA: k/v may carry FEWER heads than q (``H % H_kv == 0``) —
+    each group of ``H // H_kv`` q heads reads the same KV head, purely
+    through the KV BlockSpec index maps (kernel bodies are unchanged,
+    and KV HBM traffic drops by the group factor); dK/dV group-sums
+    per-q-head f32 partials onto the shared head.
+
     ``window=W`` (requires ``causal=True``) is sliding-window attention:
     each query attends to its own and the previous ``W - 1`` positions.
     ``W`` is static, so every pass (forward, dQ, dK/dV) *shrinks its
@@ -456,7 +500,7 @@ def _fwd(q, k, v, causal, scale, block_q, block_kv, interpret, window):
 
 def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
              block_kv, interpret, out_dtype=None, window=None,
-             q_offset=0):
+             q_offset=0, heads=None):
     """dQ for one (Tq, Tk) pair of flat arrays — used over the full
     sequence by :func:`flash_attention`'s vjp and per ring-block pair by
     :func:`blendjax.parallel.ring_attention.ring_flash_attention` (which
@@ -465,15 +509,10 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     bh, tq, d = qf.shape
     tk = kf.shape[1]
     num_q, num_kv = tq // block_q, tk // block_kv
-    if window is None:
-        kv_steps = num_kv
-        kv_im = lambda bh, i, j: (bh, j, 0)
-    else:
-        kv_steps = _kv_window_steps(num_kv, block_q, block_kv, window)
-        kv_im = _window_index_map(
-            num_kv,
-            lambda i: _kv_base(i, block_q, block_kv, window, q_offset),
-        )
+    khm = _kv_head_map(*heads) if heads else None
+    kv_steps, kv_im = _kv_axis(
+        num_kv, block_q, block_kv, window, q_offset, khm
+    )
     q_spec_i = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec_j = pl.BlockSpec((1, block_kv, d), kv_im)
     row_spec_i = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
@@ -495,12 +534,17 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
 
 def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
               block_kv, interpret, out_dtype=None, window=None,
-              q_offset=0):
+              q_offset=0, heads=None):
     """dK/dV for one (Tq, Tk) pair: kv blocks in the MIDDLE grid dim, q
-    blocks INNERMOST so the accumulators carry across q steps."""
+    blocks INNERMOST so the accumulators carry across q steps.
+
+    Under GQA (``heads=(h_q, h_kv)``) the INPUT k/v blocks come from the
+    shared KV head while the OUTPUT stays per Q head — the caller
+    group-sums the ``h_q // h_kv`` per-head partials (XLA fuses it)."""
     bh, tq, d = qf.shape
     tk = kf.shape[1]
     num_q, num_kv = tq // block_q, tk // block_kv
+    khm = _kv_head_map(*heads) if heads else None
     if window is None:
         q_steps = num_q
         q_im = lambda bh, j, i: (bh, i, 0)
@@ -511,7 +555,13 @@ def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
             lambda j: _q_base(j, block_q, block_kv, window, q_offset),
         )
     q_spec_inner = pl.BlockSpec((1, block_q, d), q_im)
-    kv_spec_mid = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
+    kv_out_spec = pl.BlockSpec((1, block_kv, d), lambda bh, j, i: (bh, j, 0))
+    if khm is None:
+        kv_in_spec = kv_out_spec
+    else:
+        kv_in_spec = pl.BlockSpec(
+            (1, block_kv, d), lambda bh, j, i: (khm(bh), j, 0)
+        )
     row_spec_inner = pl.BlockSpec((1, block_q, 1), q_im)
     return pl.pallas_call(
         functools.partial(
@@ -520,9 +570,9 @@ def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
             window=window, q_offset=q_offset,
         ),
         grid=(bh, num_kv, q_steps),
-        in_specs=[q_spec_inner, kv_spec_mid, kv_spec_mid, q_spec_inner,
+        in_specs=[q_spec_inner, kv_in_spec, kv_in_spec, q_spec_inner,
                   row_spec_inner, row_spec_inner],
-        out_specs=[kv_spec_mid, kv_spec_mid],
+        out_specs=[kv_out_spec, kv_out_spec],
         out_shape=[
             _sds((bh, tk, d), out_dtype or kf.dtype, qf),
             _sds((bh, tk, d), out_dtype or vf.dtype, qf),
@@ -535,6 +585,8 @@ def _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
 def _bwd(causal, scale, block_q, block_kv, interpret, window, res, g):
     qf, kf, vf, of, lse, qshape = res
     b, t, h, d = qshape
+    h_kv = kf.shape[0] // b
+    heads = (h, h_kv) if h_kv != h else None
     scale_v = _default_scale(scale, d)
     dof = _flat(g)
     # D_i = rowsum(dO * O): the softmax-jacobian correction term; rides
@@ -543,9 +595,26 @@ def _bwd(causal, scale, block_q, block_kv, interpret, window, res, g):
         -1, keepdims=True
     )
     dq = _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale_v, block_q,
-                  block_kv, interpret, window=window)
+                  block_kv, interpret, window=window, heads=heads)
     dk, dv = _dkv_pass(qf, kf, vf, dof, lse, delta, causal, scale_v,
-                       block_q, block_kv, interpret, window=window)
+                       block_q, block_kv, interpret, window=window,
+                       heads=heads,
+                       out_dtype=jnp.float32 if heads else None)
+    if heads is not None:
+        # GQA: the dkv pass emitted per-Q-HEAD partials (f32, so the
+        # fold never sums rounded values); fold each group's onto its
+        # shared KV head (fuses in XLA), then match the primal dtype
+        tk = kf.shape[1]
+        g_sz = h // h_kv
+
+        def _fold(x, dt):
+            return x.reshape(b, h_kv, g_sz, tk, d).sum(2).reshape(
+                -1, tk, d
+            ).astype(dt)
+
+        return (_unflat(dq, b, h),
+                _unflat(_fold(dk, kf.dtype), b, h_kv),
+                _unflat(_fold(dv, vf.dtype), b, h_kv))
     return (_unflat(dq, b, h), _unflat(dk, b, h), _unflat(dv, b, h))
 
 

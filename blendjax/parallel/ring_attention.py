@@ -84,13 +84,24 @@ def full_attention(q, k, v, causal=False, scale=None, q_offset=0, k_offset=0,
     schemes for causal masking across shards).  ``window=W`` (causal
     only) is sliding-window attention: query i sees keys in
     ``(i - W, i]`` — the reference semantics for
-    ``blendjax.ops.flash_attention``'s windowed kernel.
+    ``blendjax.ops.flash_attention``'s windowed kernel.  k/v with fewer
+    heads than q (GQA) are broadcast per group — the reference
+    semantics for the kernel's grouped KV head mapping.
     """
     if window is not None:
         if not causal:
             raise ValueError("window requires causal=True")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"q heads {q.shape[2]} must be a multiple of kv heads "
+                f"{k.shape[2]}"
+            )
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -259,6 +270,16 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
                     vary_axes, window=None):
     from blendjax.ops.flash_attention import _default_scale, _flash_fwd_impl
 
+    if k.shape[2] != q.shape[2]:
+        # the kernel itself handles GQA, but the ring-level custom VJP
+        # rotates per-q-head gradient accumulators — threading the head
+        # map through it is not implemented.  Raise here rather than let
+        # the forward silently succeed and the backward emit mis-shaped
+        # cotangents (use ulysses, or repeat kv heads upstream)
+        raise ValueError(
+            "ring_flash does not support GQA (kv heads != q heads); "
+            "use impl='ulysses' or repeat kv heads before the ring"
+        )
     if window is not None:
         if not causal:
             raise ValueError("window requires causal=True")
@@ -575,6 +596,13 @@ def zigzag_flash_attention(q, k, v, axis_name, scale=None,
 def _zz_fwd(q, k, v, axis_name, scale, interpret, vary_axes):
     from blendjax.ops.flash_attention import _default_scale, _flash_fwd_impl
 
+    if k.shape[2] != q.shape[2]:
+        # same limitation as ring_flash: the ring-level VJP rotates
+        # per-q-head accumulators (see _ring_flash_fwd)
+        raise ValueError(
+            "zigzag_flash does not support GQA (kv heads != q heads); "
+            "use impl='ulysses' or repeat kv heads before the ring"
+        )
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape

@@ -220,6 +220,57 @@ def test_make_flash_attention_window_closure():
     )
 
 
+@pytest.mark.parametrize("h_kv", [1, 2, 4])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_forward_matches_reference(h_kv, causal):
+    """Grouped-query attention (h_kv < h, incl. MQA at h_kv=1): the KV
+    BlockSpec head mapping must agree with the broadcast reference."""
+    q, _, _ = _qkv(t=128, d=16)
+    ks = jax.random.split(jax.random.PRNGKey(9), 2)
+    k = jax.random.normal(ks[0], (2, 128, h_kv, 16), jnp.float32)
+    v = jax.random.normal(ks[1], (2, 128, h_kv, 16), jnp.float32)
+    out = flash_attention(q, k, v, causal, None, 64, 32, True)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_gqa_gradients_match_reference_incl_window():
+    """dK/dV under GQA group-sum onto the shared head (f32 partials),
+    composed with sliding-window; shapes follow the kv head count."""
+    q, _, _ = _qkv(t=128, d=16)
+    ks = jax.random.split(jax.random.PRNGKey(10), 2)
+    k = jax.random.normal(ks[0], (2, 128, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[1], (2, 128, 2, 16), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(q, k, v, True, None, 64, 32, True, 48) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (full_attention(q, k, v, causal=True, window=48) ** 2).sum()
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == (2, 128, 2, 16)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_gqa_rejects_indivisible_heads():
+    q, _, _ = _qkv(t=64, d=16)  # 4 heads
+    ks = jax.random.split(jax.random.PRNGKey(11), 2)
+    k = jax.random.normal(ks[0], (2, 64, 3, 16), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, k, True, None, 64, 64, True)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        full_attention(q, k, k, causal=True)
+
+
 def test_make_flash_attention_auto_tiles_to_sequence():
     """block='auto' sizes the tile per call via flash_block_size, so the
     closure works at lengths a fixed 128 block would reject."""

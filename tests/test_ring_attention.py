@@ -197,3 +197,18 @@ def test_sliding_window_ring_traffic_scales_with_window():
                                window=1)
     hlo = jax.jit(attn).lower(q, k, v).compile().as_text()
     assert "collective-permute" not in hlo
+
+
+@pytest.mark.parametrize("impl", ["ring_flash", "zigzag_flash"])
+def test_ring_flash_rejects_gqa(qkv, impl):
+    """The ring-level custom VJPs rotate per-q-head accumulators, so
+    grouped KV heads must be rejected at entry — a silently-working
+    forward would break in the backward with mis-shaped cotangents."""
+    q, _, _ = qkv
+    mesh = make_mesh({"seq": 8})
+    ks = jax.random.split(jax.random.PRNGKey(5), 2)
+    k = jax.random.normal(ks[0], (2, 32, 2, 16), jnp.float32)  # 2 < 8 heads
+    v = jax.random.normal(ks[1], (2, 32, 2, 16), jnp.float32)
+    attn = make_ring_attention(mesh, causal=True, impl=impl)
+    with pytest.raises(ValueError, match="GQA"):
+        jax.jit(attn)(q, k, v)

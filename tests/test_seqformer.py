@@ -288,3 +288,31 @@ def test_train_step_windowed_ring_parity():
             state.params,
             ref_state.params,
         )
+
+
+def test_gqa_model_full_and_flash_agree():
+    """n_kv_heads < n_heads (grouped-query attention): the model runs
+    through both the default broadcast reference and the flash kernel's
+    grouped KV head mapping, and the two agree."""
+    from blendjax.ops.flash_attention import make_flash_attention
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=6, d_model=32, n_heads=4,
+        n_layers=2, max_len=128, n_kv_heads=2,
+    )
+    # kv projections really are smaller
+    assert params["blocks"][0]["wk"]["w"].shape == (32, 2, 8)
+    assert params["blocks"][0]["wq"]["w"].shape == (32, 4, 8)
+    obs = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 6), jnp.float32)
+    ref = seqformer.apply(params, obs, compute_dtype=jnp.float32)
+    flash = seqformer.apply(
+        params, obs, compute_dtype=jnp.float32,
+        attn_fn=make_flash_attention(causal=True, block_q=64, block_kv=64,
+                                     interpret=True),
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
+
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        seqformer.init(jax.random.PRNGKey(0), n_heads=4, n_kv_heads=3)

@@ -55,6 +55,23 @@ def test_flash_attention_sliding_window_lowers_for_tpu():
     _export_ok(jax.value_and_grad(loss, argnums=(0, 1, 2)), arg, arg, arg)
 
 
+def test_flash_attention_gqa_lowers_for_tpu():
+    """GQA (kv heads < q heads): the KV head-mapped BlockSpecs and the
+    group-summed dK/dV must clear Mosaic, composed with a window."""
+    from blendjax.ops.flash_attention import flash_attention
+
+    B, T, Hq, Hkv, D = 1, 512, 8, 2, 128
+
+    def loss(q, k, v):
+        return flash_attention(
+            q, k, v, True, None, 128, 128, False, 192
+        ).sum()
+
+    q = jax.ShapeDtypeStruct((B, T, Hq, D), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((B, T, Hkv, D), jnp.bfloat16)
+    _export_ok(jax.value_and_grad(loss, argnums=(0, 1, 2)), q, kv, kv)
+
+
 def test_flash_attention_small_head_dim_lowers_for_tpu():
     """d=64 < 128 lanes: legal only via the 'equal to the array dim'
     clause of the tiling rule — the multichip dryrun composes the kernel
