@@ -145,8 +145,12 @@ def init(
 
 
 def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
-             moe_capacity_factor, moe_dispatch="sort"):
-    """Shared forward: returns (prediction, list of per-layer MoE aux)."""
+             moe_capacity_factor, moe_dispatch="sort", kv_sink=None):
+    """Shared forward: returns (prediction, list of per-layer MoE aux).
+
+    ``kv_sink`` (a list) collects each layer's (k, v) projections —
+    :func:`rollout`'s vectorized prefill fills its KV caches from one
+    teacher-forced pass instead of t0 serial decode steps."""
     if attn_fn is None:
         def attn_fn(q, k, v):
             return full_attention(q, k, v, causal=True)
@@ -162,6 +166,8 @@ def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
             + blk[n]["b"].astype(compute_dtype)
             for n in ("wq", "wk", "wv")
         )
+        if kv_sink is not None:
+            kv_sink.append((k, v))
         a = attn_fn(q, k, v)
         o = jnp.einsum("bthk,hkd->btd", a, blk["wo"]["w"].astype(compute_dtype))
         x = x + o + blk["wo"]["b"].astype(compute_dtype)
@@ -327,3 +333,188 @@ def train_flops(batch_size, seq_len, obs_dim, d_model, n_heads, n_layers,
     fwd += tok * n_layers * (per_layer + mlp)
     fwd += 2.0 * tok * d * obs_dim  # head
     return 3.0 * fwd
+
+
+# -- autoregressive rollout (KV cache) --------------------------------------
+
+
+def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
+    """Per-layer KV caches: ``{'k': [(B, L, Hkv, Dh)], 'v': [...],
+    'pos': 0}``.  ``length`` defaults to the model's ``max_len`` (the
+    ``pos`` table); pass the actual decode horizon to size the cache —
+    and every step's attention — to the sequence you will run."""
+    length = length or params["pos"].shape[0]
+    caches = {"k": [], "v": [], "pos": jnp.asarray(0, jnp.int32)}
+    for blk in params["blocks"]:
+        _, h_kv, dh = blk["wk"]["w"].shape
+        shape = (batch_size, length, h_kv, dh)
+        caches["k"].append(jnp.zeros(shape, dtype))
+        caches["v"].append(jnp.zeros(shape, dtype))
+    return caches
+
+
+def _attn_one(q, kc, vc, pos, scale, window=None):
+    """Single-query attention over a cache: q (B, H, Dh), kc/vc
+    (B, L, Hkv, Dh); positions > ``pos`` (and, under a window, <=
+    ``pos - window``) masked.  GQA broadcasts the cached heads."""
+    b, l, h_kv, dh = kc.shape
+    h = q.shape[1]
+    if h_kv != h:
+        kc = jnp.repeat(kc, h // h_kv, axis=2)
+        vc = jnp.repeat(vc, h // h_kv, axis=2)
+    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * scale
+    idx = jnp.arange(l)
+    keep = idx <= pos
+    if window is not None:
+        keep = jnp.logical_and(keep, idx > pos - window)
+    s = jnp.where(keep[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhl,blhd->bhd", p, vc.astype(jnp.float32))
+
+
+def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
+                moe_impl="dense", moe_k=2, moe_capacity_factor=1.25,
+                moe_dispatch="sort", window=None):
+    """One incremental step: consume obs_t (B, obs_dim) at the cache's
+    current position, return (next-obs prediction (B, obs_dim) float32,
+    updated cache).  Mirrors :func:`_forward`'s block math exactly at a
+    single position — parity with the teacher-forced forward is tested.
+    """
+    from jax import lax
+
+    pos = cache["pos"]
+    x = dense_apply(params["embed"], obs_t.astype(compute_dtype),
+                    dtype=compute_dtype)
+    x = x + lax.dynamic_index_in_dim(
+        params["pos"], pos, keepdims=False
+    ).astype(compute_dtype)[None]
+    new_cache = {"k": [], "v": [], "pos": pos + 1}
+    for i, blk in enumerate(params["blocks"]):
+        h = _ln_apply(blk["ln1"], x)
+        q = jnp.einsum("bd,dhk->bhk", h, blk["wq"]["w"].astype(compute_dtype))
+        q = q + blk["wq"]["b"].astype(compute_dtype)
+        k_new = jnp.einsum("bd,dhk->bhk", h,
+                           blk["wk"]["w"].astype(compute_dtype))
+        k_new = k_new + blk["wk"]["b"].astype(compute_dtype)
+        v_new = jnp.einsum("bd,dhk->bhk", h,
+                           blk["wv"]["w"].astype(compute_dtype))
+        v_new = v_new + blk["wv"]["b"].astype(compute_dtype)
+        kc = lax.dynamic_update_slice_in_dim(
+            cache["k"][i], k_new[:, None].astype(cache["k"][i].dtype),
+            pos, axis=1,
+        )
+        vc = lax.dynamic_update_slice_in_dim(
+            cache["v"][i], v_new[:, None].astype(cache["v"][i].dtype),
+            pos, axis=1,
+        )
+        new_cache["k"].append(kc)
+        new_cache["v"].append(vc)
+        dh = q.shape[-1]
+        a = _attn_one(q, kc, vc, pos, 1.0 / jnp.sqrt(dh),
+                      window=window).astype(compute_dtype)
+        o = jnp.einsum("bhk,hkd->bd", a, blk["wo"]["w"].astype(compute_dtype))
+        x = x + o + blk["wo"]["b"].astype(compute_dtype)
+        h = _ln_apply(blk["ln2"], x)
+        if "moe" in blk:
+            h3 = h[:, None]  # the moe layers take (B, T, d)
+            if moe_impl == "topk":
+                from blendjax.models.moe import moe_apply_topk
+
+                # decode-time routing is DROP-FREE: the capacity bound
+                # exists to balance batched training dispatch, and its
+                # value depends on the total token count — so
+                # capacity-bounded routing is not causal and can never
+                # match between incremental and full-sequence evaluation.
+                # cf >= e/k guarantees a slot for every assignment here.
+                e = blk["moe"]["w1"].shape[0]
+                y, _ = moe_apply_topk(
+                    blk["moe"], h3, compute_dtype, k=moe_k,
+                    capacity_factor=max(moe_capacity_factor,
+                                        e / min(moe_k, e)),
+                    dispatch=moe_dispatch,
+                )
+            elif moe_impl == "dense":
+                y = _moe_apply(blk["moe"], h3, compute_dtype)
+            else:
+                raise ValueError(f"unknown moe_impl {moe_impl!r}")
+            x = x + y[:, 0]
+        else:
+            h = gelu(dense_apply(blk["mlp"]["fc"], h, dtype=compute_dtype))
+            x = x + dense_apply(blk["mlp"]["proj"], h, dtype=compute_dtype)
+    x = _ln_apply(params["ln_f"], x)
+    return dense_apply(params["head"], x, dtype=jnp.float32), new_cache
+
+
+def rollout(params, prefix, n_steps, compute_dtype=jnp.bfloat16,
+            moe_impl="dense", moe_k=2, moe_capacity_factor=1.25,
+            moe_dispatch="sort", window=None, cache_dtype=None):
+    """Autoregressive world-model rollout ("dreaming"): consume the
+    ``prefix`` episode (B, T0, obs_dim), then feed the model its own
+    next-observation predictions for ``n_steps`` more steps.
+
+    Returns (B, n_steps, obs_dim) float32 predictions for positions
+    T0 .. T0+n_steps-1.  Incremental per-step cost is O(L) attention
+    over the KV cache instead of re-running the O(T^2) forward on the
+    growing sequence; parity with exactly that naive re-run is tested.
+    Jit-compatible (both phases are ``lax.scan``s over static lengths).
+
+    The reference has no sequence models, let alone an inference path
+    (SURVEY.md §5); this completes the world-model workload the
+    framework adds.
+    """
+    b, t0, obs_dim = prefix.shape
+    max_len = params["pos"].shape[0]
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    if t0 < 1:
+        raise ValueError("prefix must contain at least one observation")
+    if t0 + n_steps > max_len:
+        raise ValueError(
+            f"prefix {t0} + rollout {n_steps} exceeds max_len {max_len}"
+        )
+    from jax import lax
+
+    # drop-free MoE routing on BOTH phases (see decode_step): routing
+    # must be per-token independent for the vectorized prefill and the
+    # incremental decode to agree
+    cf = moe_capacity_factor
+    for blk in params["blocks"]:
+        if "moe" in blk:
+            e = blk["moe"]["w1"].shape[0]
+            cf = max(cf, e / min(moe_k, e))
+            break
+    step_kwargs = dict(
+        compute_dtype=compute_dtype, moe_impl=moe_impl, moe_k=moe_k,
+        moe_capacity_factor=cf, moe_dispatch=moe_dispatch, window=window,
+    )
+
+    # vectorized prefill: ONE teacher-forced pass fills every layer's
+    # KV cache (the standard prefill/decode split) — not t0 serial
+    # decode steps
+    kvs = []
+    preds, _ = _forward(
+        params, prefix,
+        lambda q, k, v: full_attention(q, k, v, causal=True,
+                                       window=window),
+        compute_dtype, moe_impl, moe_k, cf, moe_dispatch, kv_sink=kvs,
+    )
+    last_pred = preds[:, -1]  # prediction for position t0
+    cache_dt = cache_dtype or compute_dtype
+    total = t0 + n_steps
+    cache = init_cache(params, b, dtype=cache_dt, length=total)
+    cache["pos"] = jnp.asarray(t0, jnp.int32)
+    for i, (k, v) in enumerate(kvs):
+        cache["k"][i] = cache["k"][i].at[:, :t0].set(k.astype(cache_dt))
+        cache["v"][i] = cache["v"][i].at[:, :t0].set(v.astype(cache_dt))
+
+    def dream(carry, _):
+        cache, obs_t = carry
+        pred, cache = decode_step(params, cache, obs_t, **step_kwargs)
+        return (cache, pred), obs_t
+
+    (_, final), dreamed = lax.scan(
+        dream, (cache, last_pred), None, length=n_steps - 1
+    )
+    out = jnp.concatenate([dreamed, final[None]], axis=0)
+    return out.transpose(1, 0, 2)

@@ -108,6 +108,54 @@ def train_on_episodes(batches, state=None, attn=None, d_model=128,
     return state, losses
 
 
+def simulate_episode(rng, batch, T_steps=None):
+    """Host-side damped-pendulum episodes with the producer's dynamics
+    (pendulum.blend.py's integrator, minus the scene): held-out
+    evaluation data for :func:`dream` without a Blender fleet."""
+    T_steps = T_steps or T
+    eps = []
+    for _ in range(batch):
+        th = rng.uniform(-2.0, 2.0)
+        om = rng.uniform(-1.0, 1.0)
+        amp = rng.uniform(0.2, 1.5)
+        freq = rng.uniform(0.5, 2.0)
+        t = 0.0
+        obs = []
+        for _f in range(T_steps + 1):
+            drive = amp * np.sin(freq * t)
+            om += (-9.81 / 2.0 * np.sin(th) - 0.15 * om + drive) * 0.05
+            th += om * 0.05
+            t += 0.05
+            o = np.zeros(OBS_DIM, np.float32)
+            o[0], o[1], o[2] = np.cos(th), np.sin(th), om
+            o[3] = amp * np.sin(freq * t)
+            # bob world position: Ry(theta) @ (0, 0, -2), matching the
+            # producer's parented sphere
+            o[4] = -2.0 * np.sin(th)
+            o[6] = -2.0 * np.cos(th)
+            obs.append(o)
+        eps.append(np.stack(obs))
+    return np.stack(eps)
+
+
+def dream(state, episode, prefix_len, n_steps, window=None):
+    """Roll the trained world model forward without the simulator: feed
+    ``prefix_len`` real observations, then its own predictions for
+    ``n_steps`` — the KV-cache inference path (seqformer.rollout).
+    Returns (predicted (B, n_steps, D), open-loop MSE vs the real
+    continuation)."""
+    params = jax.device_get(state.params)  # local copy; works for
+    # sharded states too (dreaming is cheap single-device math)
+    prefix = jnp.asarray(episode[:, :prefix_len], jnp.float32)
+    preds = seqformer.rollout(
+        params, prefix, n_steps, compute_dtype=jnp.float32,
+        window=window,
+    )
+    real = episode[:, prefix_len:prefix_len + n_steps]
+    mse = float(jnp.mean((preds - jnp.asarray(real, jnp.float32)) ** 2))
+    return preds, mse
+
+
 def sharded_transform(batch):
     """Host-side transform for the mesh path: split the episode into the
     obs/target views the sharded step trains on (an episode's T+1 length
@@ -158,6 +206,11 @@ def main():
                     choices=list(SINGLE_ATTN) + list(PARALLEL_ATTN),
                     help="default: full (single device) / ring_flash "
                          "(--mesh)")
+    ap.add_argument("--dream", type=int, default=0,
+                    help="after training, roll the model forward this "
+                         "many steps open-loop from a held-out episode "
+                         "prefix and report the MSE vs the real "
+                         "continuation")
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention width (causal); on "
                          "the ring schemes the ring then rotates only "
@@ -207,6 +260,17 @@ def main():
                 )
     print(f"trained {len(losses)} batches; "
           f"loss {losses[0]:.5f} -> {losses[-1]:.5f}")
+    if args.dream > 0:
+        rng = np.random.default_rng(123)
+        # a fresh pendulum episode the model never saw, generated with
+        # the producer's own dynamics
+        episode = simulate_episode(rng, batch=2)
+        prefix_len = T // 2
+        n_steps = min(args.dream, T - prefix_len)
+        _, mse = dream(state, episode, prefix_len, n_steps,
+                       window=args.window)
+        print(f"dream: {n_steps} open-loop steps from a {prefix_len}-step "
+              f"prefix, MSE vs real continuation {mse:.5f}")
 
 
 if __name__ == "__main__":

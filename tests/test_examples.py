@@ -327,3 +327,32 @@ def test_worldmodel_pendulum_producer_streams_episodes(monkeypatch):
         assert item["obs_seq"].dtype == np.float32
         # the pendulum actually swings: bob world positions move
         assert np.std(item["obs_seq"][:, 4:7]) > 0.01
+
+
+def test_worldmodel_dream_open_loop():
+    """The dream path: train briefly on synthetic episodes, then roll
+    the model open-loop with the KV-cache rollout and score against the
+    real continuation; the simulator helper must match the producer's
+    episode schema."""
+    wm = load_example("worldmodel/train_worldmodel.py")
+    rng = np.random.default_rng(0)
+    ep = wm.simulate_episode(rng, batch=2)
+    assert ep.shape == (2, wm.T + 1, wm.OBS_DIM)
+    # bob world positions obey the parented-sphere kinematics
+    np.testing.assert_allclose(
+        ep[..., 4], -2.0 * ep[..., 1], atol=1e-5
+    )
+
+    def batches():
+        for _ in range(6):
+            yield {"episode": jax.device_put(wm.simulate_episode(
+                rng, batch=4
+            ).astype(np.float16))}
+
+    state, _ = wm.train_on_episodes(
+        batches(), d_model=32, n_heads=2, n_layers=1, log_every=0
+    )
+    preds, mse = wm.dream(state, wm.simulate_episode(rng, batch=2),
+                          prefix_len=32, n_steps=8)
+    assert preds.shape == (2, 8, wm.OBS_DIM)
+    assert np.isfinite(mse)
