@@ -33,7 +33,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from blendjax.models.layers import dense_apply, dense_init, gelu
+from blendjax.models.layers import (
+    apply_rope,
+    dense_apply,
+    dense_init,
+    gelu,
+    rope_table,
+)
 from blendjax.parallel.ring_attention import full_attention
 
 
@@ -82,6 +88,7 @@ def init(
     n_experts=0,
     max_len=1024,
     n_kv_heads=None,
+    pos_encoding="learned",
 ):
     """Initialize SeqFormer params.
 
@@ -93,6 +100,15 @@ def init(
     ``attn_fn`` seam; the ring sequence-parallel schemes reject them
     (their ring-level VJPs rotate per-q-head accumulators) — use
     ulysses or repeat kv heads upstream there.
+
+    ``pos_encoding='rope'`` replaces the learned position table with
+    rotary embeddings applied to q/k: positions become RELATIVE, so
+    sequence length — training or :func:`rollout` horizon — is no
+    longer bounded by ``max_len`` (which is then ignored), and the
+    rotation happens before the ``attn_fn`` seam so every attention
+    scheme (flash, windowed, GQA, ring/ulysses sequence parallelism)
+    composes unchanged.  Practical horizon ~1e5-1e6 positions — f32
+    angle precision, see :func:`blendjax.models.layers.rope_table`.
     """
     d_ff = d_ff or 4 * d_model
     if d_model % n_heads:
@@ -103,14 +119,21 @@ def init(
             f"n_heads {n_heads} not divisible by n_kv_heads {n_kv_heads}"
         )
     dh = d_model // n_heads
+    if pos_encoding == "rope" and dh % 2:
+        raise ValueError(f"rope needs an even head dim, got {dh}")
+    if pos_encoding not in ("learned", "rope"):
+        raise ValueError(f"unknown pos_encoding {pos_encoding!r}")
     keys = jax.random.split(key, 3 + n_layers)
     params = {
         "embed": dense_init(keys[0], obs_dim, d_model),
-        "pos": jax.random.normal(keys[1], (max_len, d_model)) * 0.02,
         "blocks": [],
         "ln_f": _ln_init(d_model),
         "head": dense_init(keys[2], d_model, obs_dim),
     }
+    if pos_encoding == "learned":
+        # absence of the table IS the rope marker: the checkpoint stays
+        # a plain array pytree and remains self-describing
+        params["pos"] = jax.random.normal(keys[1], (max_len, d_model)) * 0.02
     scale = jnp.sqrt(1.0 / d_model)
     for i in range(n_layers):
         ka, km = jax.random.split(keys[3 + i])
@@ -157,8 +180,13 @@ def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
 
     b, t, _ = obs.shape
     auxs = []
+    use_rope = "pos" not in params
     x = dense_apply(params["embed"], obs.astype(compute_dtype), dtype=compute_dtype)
-    x = x + params["pos"][:t].astype(compute_dtype)[None]
+    if use_rope:
+        dh = params["blocks"][0]["wq"]["w"].shape[-1]
+        cos, sin = rope_table(jnp.arange(t), dh)
+    else:
+        x = x + params["pos"][:t].astype(compute_dtype)[None]
     for blk in params["blocks"]:
         h = _ln_apply(blk["ln1"], x)
         q, k, v = (
@@ -166,6 +194,12 @@ def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
             + blk[n]["b"].astype(compute_dtype)
             for n in ("wq", "wk", "wv")
         )
+        if use_rope:
+            # rotate BEFORE the kv sink and the attn seam: caches store
+            # rotated keys, and every attention scheme sees pre-rotated
+            # q/k (rotation by absolute position makes scores relative)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         if kv_sink is not None:
             kv_sink.append((k, v))
         a = attn_fn(q, k, v)
@@ -342,8 +376,16 @@ def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
     """Per-layer KV caches: ``{'k': [(B, L, Hkv, Dh)], 'v': [...],
     'pos': 0}``.  ``length`` defaults to the model's ``max_len`` (the
     ``pos`` table); pass the actual decode horizon to size the cache —
-    and every step's attention — to the sequence you will run."""
-    length = length or params["pos"].shape[0]
+    and every step's attention — to the sequence you will run.  Rope
+    models have no table and no inherent bound: ``length`` is required.
+    """
+    if length is None:
+        if "pos" not in params:
+            raise ValueError(
+                "rope models have no max_len; pass the decode horizon "
+                "as length="
+            )
+        length = params["pos"].shape[0]
     caches = {"k": [], "v": [], "pos": jnp.asarray(0, jnp.int32)}
     for blk in params["blocks"]:
         _, h_kv, dh = blk["wk"]["w"].shape
@@ -384,11 +426,16 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
     from jax import lax
 
     pos = cache["pos"]
+    use_rope = "pos" not in params
     x = dense_apply(params["embed"], obs_t.astype(compute_dtype),
                     dtype=compute_dtype)
-    x = x + lax.dynamic_index_in_dim(
-        params["pos"], pos, keepdims=False
-    ).astype(compute_dtype)[None]
+    if use_rope:
+        dh0 = params["blocks"][0]["wq"]["w"].shape[-1]
+        cos, sin = rope_table(pos[None], dh0)
+    else:
+        x = x + lax.dynamic_index_in_dim(
+            params["pos"], pos, keepdims=False
+        ).astype(compute_dtype)[None]
     new_cache = {"k": [], "v": [], "pos": pos + 1}
     for i, blk in enumerate(params["blocks"]):
         h = _ln_apply(blk["ln1"], x)
@@ -400,6 +447,9 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
         v_new = jnp.einsum("bd,dhk->bhk", h,
                            blk["wv"]["w"].astype(compute_dtype))
         v_new = v_new + blk["wv"]["b"].astype(compute_dtype)
+        if use_rope:
+            q = apply_rope(q, cos, sin)
+            k_new = apply_rope(k_new, cos, sin)
         kc = lax.dynamic_update_slice_in_dim(
             cache["k"][i], k_new[:, None].astype(cache["k"][i].dtype),
             pos, axis=1,
@@ -464,14 +514,15 @@ def rollout(params, prefix, n_steps, compute_dtype=jnp.bfloat16,
     framework adds.
     """
     b, t0, obs_dim = prefix.shape
-    max_len = params["pos"].shape[0]
     if n_steps < 1:
         raise ValueError(f"n_steps must be >= 1, got {n_steps}")
     if t0 < 1:
         raise ValueError("prefix must contain at least one observation")
-    if t0 + n_steps > max_len:
+    if "pos" in params and t0 + n_steps > params["pos"].shape[0]:
+        # rope models ("pos" absent) have no table and no length bound
         raise ValueError(
-            f"prefix {t0} + rollout {n_steps} exceeds max_len {max_len}"
+            f"prefix {t0} + rollout {n_steps} exceeds max_len "
+            f"{params['pos'].shape[0]}"
         )
     from jax import lax
 

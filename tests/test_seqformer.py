@@ -385,3 +385,121 @@ def test_rollout_validates_lengths():
         seqformer.rollout(params, prefix, 3)
     with pytest.raises(ValueError, match="n_steps"):
         seqformer.rollout(params, prefix, 0)
+
+
+def test_rope_scores_are_relative():
+    """The rope property the unbounded rollout rests on: shifting every
+    position by a constant leaves q·k scores unchanged."""
+    from blendjax.models.layers import apply_rope, rope_table
+
+    kq, kk = jax.random.split(jax.random.PRNGKey(0))
+    q = jax.random.normal(kq, (1, 8, 2, 16), jnp.float32)
+    k = jax.random.normal(kk, (1, 8, 2, 16), jnp.float32)
+
+    def scores(shift):
+        cos, sin = rope_table(jnp.arange(8) + shift, 16)
+        qr, kr = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        return jnp.einsum("bqhd,bkhd->bhqk", qr, kr)
+
+    np.testing.assert_allclose(
+        np.asarray(scores(0)), np.asarray(scores(1000)), atol=2e-4
+    )
+
+
+def test_rope_model_trains_and_is_causal():
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=OBS, d_model=32, n_heads=4,
+        n_layers=2, pos_encoding="rope",
+    )
+    assert "pos" not in params
+    batch = _batch(jax.random.PRNGKey(1))
+    out = seqformer.apply(params, batch["obs"], compute_dtype=jnp.float32)
+    poked = batch["obs"].at[:, T // 2:].add(100.0)
+    out2 = seqformer.apply(params, poked, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out[:, : T // 2]), np.asarray(out2[:, : T // 2]),
+        atol=1e-5,
+    )
+    state = TrainState.create(params, optax.adam(1e-2))
+    step = make_train_step(
+        lambda p, b: seqformer.loss_fn(p, b, compute_dtype=jnp.float32),
+        optax.adam(1e-2),
+    )
+    losses = []
+    for _ in range(10):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_rope_rollout_unbounded_matches_naive():
+    """A rope model dreams PAST any learned-table limit (here: horizon
+    2x the max_len a learned model of this size would have), and the
+    KV-cache rollout still equals naive full-sequence regeneration."""
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=5, d_model=32, n_heads=4,
+        n_layers=2, max_len=8, pos_encoding="rope",  # max_len ignored
+    )
+    prefix = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 5), jnp.float32)
+    n_steps = 10  # 6 + 10 = 16 > the (ignored) max_len=8
+
+    got = jax.jit(lambda p, x: seqformer.rollout(
+        p, x, n_steps, compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+    ))(params, prefix)
+
+    seq = prefix
+    want = []
+    for _ in range(n_steps):
+        pred = seqformer.apply(params, seq, compute_dtype=jnp.float32)[:, -1]
+        want.append(pred)
+        seq = jnp.concatenate([seq, pred[:, None]], axis=1)
+    want = jnp.stack(want, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_rope_sharded_step_matches_single_device():
+    """Rope rotation happens before the attn seam on GLOBAL positions,
+    so sequence sharding must not change the numbers."""
+    import functools
+
+    from blendjax.parallel import make_ring_attention, seqformer_rules
+    from blendjax.parallel.sharding import make_sharded_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=OBS, d_model=32, n_heads=4,
+        n_layers=2, pos_encoding="rope",
+    )
+    batch = _batch(jax.random.PRNGKey(3))
+    opt = optax.sgd(1e-2)
+
+    ref_step = make_train_step(
+        lambda p, b: seqformer.loss_fn(p, b, compute_dtype=jnp.float32),
+        opt, donate=False,
+    )
+    ref_state, ref_loss = ref_step(TrainState.create(params, opt), batch)
+
+    attn = make_ring_attention(
+        mesh, causal=True, impl="ring_flash", batch_axis="data",
+        head_axis="model",
+    )
+    init_sharded, step = make_sharded_train_step(
+        functools.partial(
+            seqformer.loss_fn, attn_fn=attn, compute_dtype=jnp.float32
+        ),
+        opt, mesh, rules=seqformer_rules("model"),
+    )
+    state = init_sharded(jax.tree.map(jnp.array, params))
+    state, loss = step(state, jax.device_put(
+        batch, NamedSharding(mesh, P("data", "seq", None))
+    ))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        state.params, ref_state.params,
+    )
