@@ -396,20 +396,26 @@ def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
 
 
 def _attn_one(q, kc, vc, pos, scale, window=None):
-    """Single-query attention over a cache: q (B, H, Dh), kc/vc
-    (B, L, Hkv, Dh); positions > ``pos`` (and, under a window, <=
-    ``pos - window``) masked.  GQA broadcasts the cached heads."""
-    b, l, h_kv, dh = kc.shape
+    """Single-query attention over a (possibly ring-buffer) cache: q
+    (B, H, Dh), kc/vc (B, C, Hkv, Dh).  The cache is written at
+    ``slot = p % C``, so slot ``s`` currently holds absolute position
+    ``pos - ((pos - s) mod C)`` — the latest position congruent to
+    ``s`` that has been written.  Masking on that absolute position
+    unifies the no-wrap case (C >= sequence: it reduces to ``s <= pos``)
+    with the O(window)-memory ring (C >= window: overwritten slots fall
+    outside the window by construction).  GQA broadcasts the cached
+    heads."""
+    b, c, h_kv, dh = kc.shape
     h = q.shape[1]
     if h_kv != h:
         kc = jnp.repeat(kc, h // h_kv, axis=2)
         vc = jnp.repeat(vc, h // h_kv, axis=2)
     s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
                    kc.astype(jnp.float32)) * scale
-    idx = jnp.arange(l)
-    keep = idx <= pos
+    slot_pos = pos - ((pos - jnp.arange(c)) % c)
+    keep = slot_pos >= 0  # never-written slots sit at negative positions
     if window is not None:
-        keep = jnp.logical_and(keep, idx > pos - window)
+        keep = jnp.logical_and(keep, slot_pos > pos - window)
     s = jnp.where(keep[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhl,blhd->bhd", p, vc.astype(jnp.float32))
@@ -422,6 +428,14 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
     current position, return (next-obs prediction (B, obs_dim) float32,
     updated cache).  Mirrors :func:`_forward`'s block math exactly at a
     single position — parity with the teacher-forced forward is tested.
+
+    The cache is a RING buffer: writes land at ``pos % C`` and masking
+    is by each slot's absolute position (see :func:`_attn_one`), so a
+    cache of ``C >= window`` slots supports an unbounded decode horizon
+    at O(window) memory.  A cache shorter than the sequence with NO
+    window effectively attends to the last ``C`` positions only —
+    size the cache to the horizon (what :func:`rollout` does) unless
+    you want exactly that.
     """
     from jax import lax
 
@@ -450,13 +464,14 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
         if use_rope:
             q = apply_rope(q, cos, sin)
             k_new = apply_rope(k_new, cos, sin)
+        slot = pos % cache["k"][i].shape[1]  # ring buffer (see _attn_one)
         kc = lax.dynamic_update_slice_in_dim(
             cache["k"][i], k_new[:, None].astype(cache["k"][i].dtype),
-            pos, axis=1,
+            slot, axis=1,
         )
         vc = lax.dynamic_update_slice_in_dim(
             cache["v"][i], v_new[:, None].astype(cache["v"][i].dtype),
-            pos, axis=1,
+            slot, axis=1,
         )
         new_cache["k"].append(kc)
         new_cache["v"].append(vc)
@@ -504,10 +519,14 @@ def rollout(params, prefix, n_steps, compute_dtype=jnp.bfloat16,
     next-observation predictions for ``n_steps`` more steps.
 
     Returns (B, n_steps, obs_dim) float32 predictions for positions
-    T0 .. T0+n_steps-1.  Incremental per-step cost is O(L) attention
+    T0 .. T0+n_steps-1.  Incremental per-step cost is O(cache) attention
     over the KV cache instead of re-running the O(T^2) forward on the
-    growing sequence; parity with exactly that naive re-run is tested.
-    Jit-compatible (both phases are ``lax.scan``s over static lengths).
+    growing sequence; under a ``window`` the cache is a RING BUFFER of
+    ``window`` slots, so memory stays O(window) however long the dream
+    (with ``pos_encoding='rope'`` the horizon is then bounded only by
+    rope's f32 angle precision).  Parity with the naive re-run is
+    tested.  Jit-compatible (the phases are one teacher-forced pass and
+    a ``lax.scan``).
 
     The reference has no sequence models, let alone an inference path
     (SURVEY.md §5); this completes the world-model workload the
@@ -553,11 +572,23 @@ def rollout(params, prefix, n_steps, compute_dtype=jnp.bfloat16,
     last_pred = preds[:, -1]  # prediction for position t0
     cache_dt = cache_dtype or compute_dtype
     total = t0 + n_steps
-    cache = init_cache(params, b, dtype=cache_dt, length=total)
+    # windowed: a ring buffer of `window` slots bounds memory at
+    # O(window) no matter the horizon (decode_step writes at pos % C,
+    # _attn_one masks by slot position)
+    length = total if window is None else min(total, window)
+    cache = init_cache(params, b, dtype=cache_dt, length=length)
     cache["pos"] = jnp.asarray(t0, jnp.int32)
+    # keep only the prefix tail that fits the ring, placed at each
+    # position's slot (distinct since we keep <= C consecutive ones)
+    keep_n = min(t0, length)
+    slots = (jnp.arange(keep_n) + (t0 - keep_n)) % length
     for i, (k, v) in enumerate(kvs):
-        cache["k"][i] = cache["k"][i].at[:, :t0].set(k.astype(cache_dt))
-        cache["v"][i] = cache["v"][i].at[:, :t0].set(v.astype(cache_dt))
+        cache["k"][i] = cache["k"][i].at[:, slots].set(
+            k[:, t0 - keep_n:].astype(cache_dt)
+        )
+        cache["v"][i] = cache["v"][i].at[:, slots].set(
+            v[:, t0 - keep_n:].astype(cache_dt)
+        )
 
     def dream(carry, _):
         cache, obs_t = carry

@@ -503,3 +503,41 @@ def test_rope_sharded_step_matches_single_device():
         ),
         state.params, ref_state.params,
     )
+
+
+@pytest.mark.parametrize("t0,W", [(6, 8), (9, 4)])
+def test_windowed_ring_cache_streams_past_capacity(t0, W):
+    """O(window) memory for unbounded dreaming: a rope model dreams 20
+    steps through a window-sized ring cache (the horizon wraps the ring
+    repeatedly) and still equals naive windowed regeneration.  The
+    (9, 4) case has t0 > window, exercising rollout's prefix-tail
+    truncation (only the last W prefix positions enter the ring, at
+    wrapped slots)."""
+    from blendjax.parallel.ring_attention import full_attention
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=5, d_model=32, n_heads=4,
+        n_layers=2, pos_encoding="rope",
+    )
+    prefix = jax.random.normal(jax.random.PRNGKey(1), (2, t0, 5),
+                               jnp.float32)
+    n_steps = 20
+
+    got = jax.jit(lambda p, x: seqformer.rollout(
+        p, x, n_steps, compute_dtype=jnp.float32,
+        cache_dtype=jnp.float32, window=W,
+    ))(params, prefix)
+
+    attn = lambda q, k, v: full_attention(q, k, v, causal=True, window=W)
+    seq = prefix
+    want = []
+    for _ in range(n_steps):
+        pred = seqformer.apply(
+            params, seq, compute_dtype=jnp.float32, attn_fn=attn
+        )[:, -1]
+        want.append(pred)
+        seq = jnp.concatenate([seq, pred[:, None]], axis=1)
+    want = jnp.stack(want, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
