@@ -212,3 +212,19 @@ def test_ring_flash_rejects_gqa(qkv, impl):
     attn = make_ring_attention(mesh, causal=True, impl=impl)
     with pytest.raises(ValueError, match="GQA"):
         jax.jit(attn)(q, k, v)
+
+
+def test_ulysses_supports_gqa():
+    """Ulysses composes with grouped KV heads: the all-to-all reshards
+    q and k/v by their own head counts (each must divide the axis) and
+    the inner attention handles the grouping — exact vs the broadcast
+    reference."""
+    mesh = make_mesh({"seq": 2})
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = jax.random.normal(ks[0], (2, 64, 8, 16), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 64, 2, 16), jnp.float32)
+    attn = make_ring_attention(mesh, causal=True, impl="ulysses")
+    got = jax.jit(attn)(q, k, v)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
