@@ -33,20 +33,31 @@ def init(key, num_keypoints=8, channels=(32, 64, 128), in_channels=3, hidden=256
     return params
 
 
-def apply(params, images, compute_dtype=jnp.bfloat16):
+def apply(params, images, compute_dtype=jnp.bfloat16, conv_fn=None,
+          dense_fn=None):
     """Forward pass.
 
     Params
     ------
     images: (N, H, W, C) float in [0, 1].
+    conv_fn / dense_fn: layer-apply overrides ``(p, x, stride) -> y`` /
+        ``(p, x) -> y`` — the seam :mod:`blendjax.ops.quant` injects its
+        int8 kernels through, so the architecture lives in exactly one
+        place.
     Returns (N, K, 2) predicted keypoints in [0, 1] normalized coordinates.
     """
+    if conv_fn is None:
+        def conv_fn(p, x, stride):
+            return conv_apply(p, x, stride=stride, dtype=compute_dtype)
+    if dense_fn is None:
+        def dense_fn(p, x):
+            return dense_apply(p, x, dtype=compute_dtype)
     x = images.astype(compute_dtype)
     for conv in params["convs"]:
-        x = gelu(conv_apply(conv, x, stride=2, dtype=compute_dtype))
+        x = gelu(conv_fn(conv, x, 2))
     x = x.mean(axis=(1, 2))  # global average pool
-    x = gelu(dense_apply(params["fc"], x, dtype=compute_dtype))
-    out = dense_apply(params["head"], x, dtype=compute_dtype)
+    x = gelu(dense_fn(params["fc"], x))
+    out = dense_fn(params["head"], x)
     k2 = out.shape[-1]
     out = jax.nn.sigmoid(out.astype(jnp.float32))
     return out.reshape(*out.shape[:-1], k2 // 2, 2)

@@ -1,6 +1,7 @@
-"""Device-side input-pipeline ops (decode, color, augmentation)."""
+"""Device-side input-pipeline ops (decode, color, augmentation) and
+inference-efficiency ops (int8 quantization)."""
 
-from blendjax.ops import augment, image
+from blendjax.ops import augment, image, quant
 from blendjax.ops.flash_attention import flash_attention, make_flash_attention
 from blendjax.ops.image import (
     decode_frames,
@@ -9,10 +10,15 @@ from blendjax.ops.image import (
     normalize,
     srgb_to_linear,
 )
+from blendjax.ops.quant import (
+    detector_apply_int8,
+    quantize_detector,
+)
 
 __all__ = [
     "augment",
     "image",
+    "quant",
     "flash_attention",
     "make_flash_attention",
     "decode_frames",
@@ -20,4 +26,6 @@ __all__ = [
     "linear_to_srgb",
     "normalize",
     "srgb_to_linear",
+    "detector_apply_int8",
+    "quantize_detector",
 ]
