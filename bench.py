@@ -458,6 +458,13 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
                 if k in kff:
                     ka[k] = kff[k]
         extras["kernel_attn"] = ka
+    kint8 = pick("int8_infer")
+    if kint8:
+        extras["int8_infer"] = {
+            k: kint8[k]
+            for k in ("bf16_step_ms", "int8_step_ms", "int8_over_bf16")
+            if k in kint8
+        }
     ktopk = pick("kernel_topk")
     ktd = pick("kernel_topk_vs_dense")
     if ktopk or ktd:

@@ -680,6 +680,63 @@ def phase_kernel_microverdicts(args, budget, tag):
             note(f"kernel_dense_moe failed: {type(e).__name__}: {e}")
 
 
+def phase_int8_infer(args, budget, tag):
+    """bf16 vs int8 (w8a8) detector INFERENCE on this device — the
+    on-chip confirmation of the quantization path's win (int8 operands
+    run the MXU at up to 2x the bf16 rate; the measured ratio ships,
+    whatever it is).  Differential-chain timing with value fences;
+    chained by feeding each step's (resized) output back as a bias so
+    the steps serialize.  TPU-only: a CPU int8 path measures emulation,
+    not the claim."""
+    if tag["platform"] != "tpu" or not budget.has(45, "int8_infer"):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from blendjax.models import detector
+    from blendjax.ops.quant import detector_apply_int8, quantize_detector
+
+    params = detector.init(jax.random.PRNGKey(0))
+    qparams = quantize_detector(params)
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(
+        rng.random((args.batch, args.height, args.width, 3), np.float32)
+    )
+
+    def chained(apply_fn, p):
+        def step(state, _):
+            x, out = state
+            # fold the previous output back into the input so chained
+            # steps have a data dependency (differential timing needs
+            # serial execution)
+            x = x + jnp.mean(out) * 1e-6
+            return (x, apply_fn(p, x)), jnp.mean(out)
+
+        return jax.jit(step)
+
+    out0 = jnp.zeros((args.batch, 8, 2), jnp.float32)
+    progress("int8_infer_compile")
+    try:
+        bf16_stats, _ = measure_step_time(
+            chained(detector.apply, params),
+            (imgs, out0), None, budget, windows=args.windows,
+        )
+        int8_stats, _ = measure_step_time(
+            chained(detector_apply_int8, qparams),
+            (imgs, out0), None, budget, windows=args.windows,
+        )
+    except Exception as e:  # noqa: BLE001 - optional exhibit
+        note(f"int8_infer failed: {type(e).__name__}: {e}")
+        return
+    r = int8_stats["step_s"] / max(bf16_stats["step_s"], 1e-9)
+    emit({"phase": "int8_infer",
+          "bf16_step_ms": round(bf16_stats["step_s"] * 1e3, 3),
+          "int8_step_ms": round(int8_stats["step_s"] * 1e3, 3),
+          "int8_over_bf16": round(r, 4),
+          "batch": args.batch, "height": args.height,
+          "width": args.width, **tag})
+
+
 def phase_cube_stream(args, budget, producers, tag):
     """Phases 1+2: cube640x480 stream -> HBM, then -> detector train."""
     import jax
@@ -1436,6 +1493,7 @@ def main(argv=None):
     strat = ("put_strategy", lambda: phase_put_strategy(args, budget, tag))
     micro = ("kernel microverdicts",
              lambda: phase_kernel_microverdicts(args, budget, tag))
+    int8 = ("int8 infer", lambda: phase_int8_infer(args, budget, tag))
 
     # trust anchor + wire ceiling always lead; after that, confirm-first
     # (the tunneled TPU) banks the owed kernel verdicts cheapest-first:
@@ -1450,7 +1508,7 @@ def main(argv=None):
     if confirm_first:
         # put_strategy is TPU-only and cheap (30s-gated): it goes right
         # after the banked verdicts, before any wire-heavy stream
-        order = [micro, seq, moe, strat, cube, seq_stream]
+        order = [micro, seq, moe, strat, int8, cube, seq_stream]
     else:
         # stream-first: run_seq executes the stream inline (no deferred
         # continuation), so seq_stream is a no-op here

@@ -48,13 +48,10 @@ def quantize_act(x):
     A whole-batch scale would couple examples — one high-activation
     outlier coarsens every other image's quantization, making outputs
     depend on batch composition; per-example scales keep inference
-    batch-independent (tested) at the same MXU path."""
-    x32 = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(x32), axis=tuple(range(1, x32.ndim)),
-                   keepdims=True)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
-    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+    batch-independent (tested) at the same MXU path.  Same quantizer as
+    the weights (:func:`quantize_tensor`), reduced over all non-batch
+    axes."""
+    return quantize_tensor(x, reduce_axes=tuple(range(1, x.ndim)))
 
 
 def quantize_dense(p):

@@ -253,3 +253,27 @@ def test_phase_put_strategy_emits_winner_and_loser(capsys):
     assert line["chunked_over_whole"] > 0
     assert {"min", "median", "max", "n"} <= set(line["whole_s"])
     assert line["fence"] == "value_fetch"
+
+
+def test_phase_int8_infer_emits_ratio(capsys):
+    """The int8-vs-bf16 inference exhibit: TPU-gated (tag-label gated —
+    the body runs fine on the CPU backend), one record with both step
+    times and the ratio."""
+    import argparse
+    import json
+
+    from benchmarks.suite_device import phase_int8_infer
+
+    args = argparse.Namespace(batch=2, height=32, width=32, windows=1)
+    phase_int8_infer(args, Budget(300), {"platform": "cpu"})
+    assert capsys.readouterr().out == ""  # cpu: no emission
+
+    phase_int8_infer(args, Budget(300), {"platform": "tpu"})
+    lines = [json.loads(s) for s in
+             capsys.readouterr().out.strip().splitlines()]
+    rec = [l for l in lines if l["phase"] == "int8_infer"]
+    assert len(rec) == 1
+    rec = rec[0]
+    assert rec["int8_over_bf16"] > 0
+    assert rec["bf16_step_ms"] > 0 and rec["int8_step_ms"] > 0
+    assert any(l["phase"] == "progress" for l in lines)
