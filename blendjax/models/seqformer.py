@@ -40,7 +40,31 @@ from blendjax.models.layers import (
     gelu,
     rope_table,
 )
+from blendjax.ops.quant import maybe_quantized_einsum
 from blendjax.parallel.ring_attention import full_attention
+
+
+def _dense_mq(p, x, dtype):
+    """``dense_apply`` accepting either a float ``{'w', 'b'}`` or an
+    int8 ``{'w_q', 'w_scale', 'b'}`` weight dict
+    (:func:`blendjax.ops.quant.quantize_seqformer`)."""
+    if "w_q" not in p:
+        return dense_apply(p, x, dtype=dtype)
+    out = maybe_quantized_einsum("...d,df->...f", x, p, dtype)
+    return (out + p["b"]).astype(dtype)
+
+
+def _proj_mq(p, x, eq, dtype):
+    """Head-major attention projection with the same float/int8
+    dispatch; bias included."""
+    out = maybe_quantized_einsum(eq, x, p, dtype)
+    b = p["b"].astype(dtype if "w_q" not in p else jnp.float32)
+    return (out + b).astype(dtype)
+
+
+def _wq_head_dim(params):
+    wq = params["blocks"][0]["wq"]
+    return (wq["w"] if "w" in wq else wq["w_q"]).shape[-1]
 
 
 def _ln_init(d):
@@ -181,17 +205,16 @@ def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
     b, t, _ = obs.shape
     auxs = []
     use_rope = "pos" not in params
-    x = dense_apply(params["embed"], obs.astype(compute_dtype), dtype=compute_dtype)
+    x = _dense_mq(params["embed"], obs.astype(compute_dtype), compute_dtype)
     if use_rope:
-        dh = params["blocks"][0]["wq"]["w"].shape[-1]
+        dh = _wq_head_dim(params)
         cos, sin = rope_table(jnp.arange(t), dh)
     else:
         x = x + params["pos"][:t].astype(compute_dtype)[None]
     for blk in params["blocks"]:
         h = _ln_apply(blk["ln1"], x)
         q, k, v = (
-            jnp.einsum("btd,dhk->bthk", h, blk[n]["w"].astype(compute_dtype))
-            + blk[n]["b"].astype(compute_dtype)
+            _proj_mq(blk[n], h, "btd,dhk->bthk", compute_dtype)
             for n in ("wq", "wk", "wv")
         )
         if use_rope:
@@ -203,8 +226,7 @@ def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
         if kv_sink is not None:
             kv_sink.append((k, v))
         a = attn_fn(q, k, v)
-        o = jnp.einsum("bthk,hkd->btd", a, blk["wo"]["w"].astype(compute_dtype))
-        x = x + o + blk["wo"]["b"].astype(compute_dtype)
+        x = x + _proj_mq(blk["wo"], a, "bthk,hkd->btd", compute_dtype)
         h = _ln_apply(blk["ln2"], x)
         if "moe" in blk:
             if moe_impl == "topk":
@@ -222,10 +244,10 @@ def _forward(params, obs, attn_fn, compute_dtype, moe_impl, moe_k,
             else:
                 raise ValueError(f"unknown moe_impl {moe_impl!r}")
         else:
-            h = gelu(dense_apply(blk["mlp"]["fc"], h, dtype=compute_dtype))
-            x = x + dense_apply(blk["mlp"]["proj"], h, dtype=compute_dtype)
+            h = gelu(_dense_mq(blk["mlp"]["fc"], h, compute_dtype))
+            x = x + _dense_mq(blk["mlp"]["proj"], h, compute_dtype)
     x = _ln_apply(params["ln_f"], x)
-    return dense_apply(params["head"], x, dtype=jnp.float32), auxs
+    return _dense_mq(params["head"], x, jnp.float32), auxs
 
 
 def apply(params, obs, attn_fn=None, compute_dtype=jnp.bfloat16,
@@ -388,7 +410,8 @@ def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
         length = params["pos"].shape[0]
     caches = {"k": [], "v": [], "pos": jnp.asarray(0, jnp.int32)}
     for blk in params["blocks"]:
-        _, h_kv, dh = blk["wk"]["w"].shape
+        wk = blk["wk"]
+        _, h_kv, dh = (wk["w"] if "w" in wk else wk["w_q"]).shape
         shape = (batch_size, length, h_kv, dh)
         caches["k"].append(jnp.zeros(shape, dtype))
         caches["v"].append(jnp.zeros(shape, dtype))
@@ -441,11 +464,10 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
 
     pos = cache["pos"]
     use_rope = "pos" not in params
-    x = dense_apply(params["embed"], obs_t.astype(compute_dtype),
-                    dtype=compute_dtype)
+    x = _dense_mq(params["embed"], obs_t.astype(compute_dtype),
+                  compute_dtype)
     if use_rope:
-        dh0 = params["blocks"][0]["wq"]["w"].shape[-1]
-        cos, sin = rope_table(pos[None], dh0)
+        cos, sin = rope_table(pos[None], _wq_head_dim(params))
     else:
         x = x + lax.dynamic_index_in_dim(
             params["pos"], pos, keepdims=False
@@ -453,14 +475,9 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
     new_cache = {"k": [], "v": [], "pos": pos + 1}
     for i, blk in enumerate(params["blocks"]):
         h = _ln_apply(blk["ln1"], x)
-        q = jnp.einsum("bd,dhk->bhk", h, blk["wq"]["w"].astype(compute_dtype))
-        q = q + blk["wq"]["b"].astype(compute_dtype)
-        k_new = jnp.einsum("bd,dhk->bhk", h,
-                           blk["wk"]["w"].astype(compute_dtype))
-        k_new = k_new + blk["wk"]["b"].astype(compute_dtype)
-        v_new = jnp.einsum("bd,dhk->bhk", h,
-                           blk["wv"]["w"].astype(compute_dtype))
-        v_new = v_new + blk["wv"]["b"].astype(compute_dtype)
+        q = _proj_mq(blk["wq"], h, "bd,dhk->bhk", compute_dtype)
+        k_new = _proj_mq(blk["wk"], h, "bd,dhk->bhk", compute_dtype)
+        v_new = _proj_mq(blk["wv"], h, "bd,dhk->bhk", compute_dtype)
         if use_rope:
             q = apply_rope(q, cos, sin)
             k_new = apply_rope(k_new, cos, sin)
@@ -478,8 +495,7 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
         dh = q.shape[-1]
         a = _attn_one(q, kc, vc, pos, 1.0 / jnp.sqrt(dh),
                       window=window).astype(compute_dtype)
-        o = jnp.einsum("bhk,hkd->bd", a, blk["wo"]["w"].astype(compute_dtype))
-        x = x + o + blk["wo"]["b"].astype(compute_dtype)
+        x = x + _proj_mq(blk["wo"], a, "bhk,hkd->bd", compute_dtype)
         h = _ln_apply(blk["ln2"], x)
         if "moe" in blk:
             h3 = h[:, None]  # the moe layers take (B, T, d)
@@ -505,10 +521,10 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
                 raise ValueError(f"unknown moe_impl {moe_impl!r}")
             x = x + y[:, 0]
         else:
-            h = gelu(dense_apply(blk["mlp"]["fc"], h, dtype=compute_dtype))
-            x = x + dense_apply(blk["mlp"]["proj"], h, dtype=compute_dtype)
+            h = gelu(_dense_mq(blk["mlp"]["fc"], h, compute_dtype))
+            x = x + _dense_mq(blk["mlp"]["proj"], h, compute_dtype)
     x = _ln_apply(params["ln_f"], x)
-    return dense_apply(params["head"], x, dtype=jnp.float32), new_cache
+    return _dense_mq(params["head"], x, jnp.float32), new_cache
 
 
 def rollout(params, prefix, n_steps, compute_dtype=jnp.bfloat16,

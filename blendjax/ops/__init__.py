@@ -13,6 +13,7 @@ from blendjax.ops.image import (
 from blendjax.ops.quant import (
     detector_apply_int8,
     quantize_detector,
+    quantize_seqformer,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "srgb_to_linear",
     "detector_apply_int8",
     "quantize_detector",
+    "quantize_seqformer",
 ]

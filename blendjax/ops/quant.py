@@ -91,6 +91,92 @@ def conv_apply_int8(qp, x, stride=1, padding="SAME"):
     return acc.astype(jnp.float32) * (xs * qp["w_scale"]) + qp["b"]
 
 
+def _x_contracted_axes(eq, x_ndim):
+    """Axes of the FIRST einsum operand that are contracted away (their
+    labels don't reach the output) — exactly the axes an activation
+    scale must be constant over for dequantization to be exact."""
+    lhs, out = eq.split("->")
+    xspec = lhs.split(",")[0]
+    if "..." in xspec:
+        head, tail = xspec.split("...")
+        if head:
+            # labels BEFORE the ellipsis would need leading-axis index
+            # math this helper doesn't do — reject loudly rather than
+            # silently mis-scale (no call site needs the form)
+            raise NotImplementedError(
+                f"einsum spec {xspec!r}: put named x labels after '...'"
+            )
+        offset = x_ndim - len(tail)
+        return tuple(offset + i for i, c in enumerate(tail)
+                     if c not in out)
+    return tuple(i for i, c in enumerate(xspec) if c not in out)
+
+
+def maybe_quantized_einsum(eq, x, p, dtype):
+    """``einsum(eq, x, w)`` that dispatches on the weight dict: float
+    (``{'w'}``) runs in ``dtype``; quantized (``{'w_q', 'w_scale'}``)
+    quantizes ``x`` with one scale per OUTPUT-surviving coordinate —
+    i.e. reduced over exactly the contracted axes (a scale varying
+    within a contraction could not be factored out of the int32 sum,
+    and a scale pooled over kept axes like the sequence would let
+    future positions change a past token's quantization, breaking
+    causality and prefill/decode parity) — int8-einsums to int32, and
+    dequantizes by running the SAME einsum over the keepdims scales
+    (contracted scale axes have size 1, so the 'sum' is exactly the
+    product of scales: one rule for every equation)."""
+    if "w_q" not in p:
+        return jnp.einsum(eq, x, p["w"].astype(dtype))
+    xq, xs = quantize_tensor(x, reduce_axes=_x_contracted_axes(eq, x.ndim))
+    acc = jnp.einsum(eq, xq, p["w_q"], preferred_element_type=jnp.int32)
+    scale = jnp.einsum(eq, xs, p["w_scale"])
+    return acc.astype(jnp.float32) * scale
+
+
+def quantize_seqformer(params):
+    """Offline PTQ of a :mod:`blendjax.models.seqformer` pytree for
+    INFERENCE (:func:`seqformer.apply` / :func:`seqformer.rollout`):
+    attention projections, MLP, embed, and head go w8 (per-output
+    scales); layernorms, biases, the pos table, and MoE blocks (gate
+    routing is precision-sensitive) stay f32.
+
+    The quantized pytree keeps the model's STRUCTURE (each ``{'w'}``
+    becomes ``{'w_q', 'w_scale', 'b'}``), and the forward dispatches per
+    weight dict (:func:`maybe_quantized_einsum`), so the same model code
+    serves both precisions."""
+
+    def qd(p, reduce_axes):
+        q, s = quantize_tensor(p["w"], reduce_axes)
+        return {"w_q": q, "w_scale": s,
+                "b": p["b"].astype(jnp.float32)}
+
+    out = {
+        "embed": qd(params["embed"], (0,)),
+        "head": qd(params["head"], (0,)),
+        "ln_f": params["ln_f"],
+        "blocks": [],
+    }
+    if "pos" in params:
+        out["pos"] = params["pos"]
+    for blk in params["blocks"]:
+        qb = {
+            "ln1": blk["ln1"],
+            "ln2": blk["ln2"],
+            "wq": qd(blk["wq"], (0,)),
+            "wk": qd(blk["wk"], (0,)),
+            "wv": qd(blk["wv"], (0,)),
+            "wo": qd(blk["wo"], (0, 1)),
+        }
+        if "mlp" in blk:
+            qb["mlp"] = {
+                "fc": qd(blk["mlp"]["fc"], (0,)),
+                "proj": qd(blk["mlp"]["proj"], (0,)),
+            }
+        if "moe" in blk:
+            qb["moe"] = blk["moe"]
+        out["blocks"].append(qb)
+    return out
+
+
 def quantize_detector(params):
     """Offline PTQ of a trained :mod:`blendjax.models.detector` pytree:
     every conv and dense layer goes w8; biases stay f32."""

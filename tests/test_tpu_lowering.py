@@ -72,6 +72,30 @@ def test_flash_attention_gqa_lowers_for_tpu():
     _export_ok(jax.value_and_grad(loss, argnums=(0, 1, 2)), q, kv, kv)
 
 
+def test_quantized_seqformer_rollout_lowers_for_tpu():
+    """int8 w8a8 SeqFormer dreaming: the quantized rollout (vectorized
+    prefill + ring-buffer decode, int8 einsums to int32) must export
+    compiled for TPU."""
+    from blendjax.models import seqformer
+    from blendjax.ops.quant import quantize_seqformer
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=4, d_model=32, n_heads=4,
+        n_layers=1, pos_encoding="rope",
+    )
+    qparams = quantize_seqformer(params)
+
+    def dream(q, prefix):
+        return seqformer.rollout(q, prefix, 8, compute_dtype=jnp.float32,
+                                 window=8)
+
+    prefix = jax.ShapeDtypeStruct((2, 6, 4), jnp.float32)
+    exp = jax.export.export(jax.jit(dream), platforms=["tpu"])(
+        qparams, prefix
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_flash_attention_small_head_dim_lowers_for_tpu():
     """d=64 < 128 lanes: legal only via the 'equal to the array dim'
     clause of the tiling rule — the multichip dryrun composes the kernel
