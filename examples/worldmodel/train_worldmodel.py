@@ -84,7 +84,8 @@ def make_attn(name, seq_len, window=None):
 
 
 def train_on_episodes(batches, state=None, attn=None, d_model=128,
-                      n_heads=4, n_layers=2, log_every=8):
+                      n_heads=4, n_layers=2, log_every=8,
+                      pos_encoding="learned"):
     """Train the SeqFormer over an iterator of device episode batches."""
     import functools
 
@@ -93,6 +94,7 @@ def train_on_episodes(batches, state=None, attn=None, d_model=128,
         params = seqformer.init(
             jax.random.PRNGKey(0), obs_dim=OBS_DIM, d_model=d_model,
             n_heads=n_heads, n_layers=n_layers, max_len=T,
+            pos_encoding=pos_encoding,
         )
         state = TrainState.create(params, opt)
     loss_fn = seqformer.episode_loss_fn
@@ -138,7 +140,7 @@ def simulate_episode(rng, batch, T_steps=None):
     return np.stack(eps)
 
 
-def dream(state, episode, prefix_len, n_steps, window=None):
+def dream(state, episode, prefix_len, n_steps, window=None, int8=False):
     """Roll the trained world model forward without the simulator: feed
     ``prefix_len`` real observations, then its own predictions for
     ``n_steps`` — the KV-cache inference path (seqformer.rollout).
@@ -146,6 +148,10 @@ def dream(state, episode, prefix_len, n_steps, window=None):
     continuation)."""
     params = jax.device_get(state.params)  # local copy; works for
     # sharded states too (dreaming is cheap single-device math)
+    if int8:
+        from blendjax.ops.quant import quantize_seqformer
+
+        params = quantize_seqformer(params)
     prefix = jnp.asarray(episode[:, :prefix_len], jnp.float32)
     preds = seqformer.rollout(
         params, prefix, n_steps, compute_dtype=jnp.float32,
@@ -206,6 +212,15 @@ def main():
                     choices=list(SINGLE_ATTN) + list(PARALLEL_ATTN),
                     help="default: full (single device) / ring_flash "
                          "(--mesh)")
+    ap.add_argument("--pos", choices=["learned", "rope"],
+                    default="learned",
+                    help="position encoding (rope: relative positions, "
+                         "dream horizons unbounded by max_len; "
+                         "single-device path only here)")
+    ap.add_argument("--dream-int8", action="store_true",
+                    help="quantize the trained model (w8a8) before "
+                         "dreaming — the bandwidth-bound decode phase "
+                         "benefits most from int8 weights")
     ap.add_argument("--dream", type=int, default=0,
                     help="after training, roll the model forward this "
                          "many steps open-loop from a held-out episode "
@@ -227,6 +242,12 @@ def main():
         if attn not in PARALLEL_ATTN:
             ap.error(f"--mesh needs a parallel --attn {PARALLEL_ATTN}, "
                      f"got {attn!r}")
+        if args.pos == "rope":
+            # silently training learned positions under a --pos rope
+            # flag would invalidate whatever comparison the user thinks
+            # they ran (same policy as the attn-name validation above)
+            ap.error("--pos rope is single-device-path only here; drop "
+                     "--mesh or --pos")
         mesh_shape = tuple(int(x) for x in args.mesh.split(","))
         state, step, batch_sharding = make_sharded_trainer(
             mesh_shape, attn, window=args.window
@@ -256,19 +277,25 @@ def main():
                 state, losses = train_sharded(iter(stream), state, step)
             else:
                 state, losses = train_on_episodes(
-                    iter(stream), attn=attn_fn
+                    iter(stream), attn=attn_fn, pos_encoding=args.pos
                 )
     print(f"trained {len(losses)} batches; "
           f"loss {losses[0]:.5f} -> {losses[-1]:.5f}")
     if args.dream > 0:
         rng = np.random.default_rng(123)
-        # a fresh pendulum episode the model never saw, generated with
-        # the producer's own dynamics
-        episode = simulate_episode(rng, batch=2)
         prefix_len = T // 2
-        n_steps = min(args.dream, T - prefix_len)
+        if args.pos == "rope" and not args.mesh:
+            # rope has no table bound: honor the requested horizon by
+            # simulating a long enough held-out episode to score it
+            n_steps = args.dream
+        else:
+            n_steps = min(args.dream, T - prefix_len)
+        # a fresh pendulum episode the model never saw, generated with
+        # the producer's own dynamics — long enough to cover the dream
+        episode = simulate_episode(rng, batch=2,
+                                   T_steps=prefix_len + n_steps)
         _, mse = dream(state, episode, prefix_len, n_steps,
-                       window=args.window)
+                       window=args.window, int8=args.dream_int8)
         print(f"dream: {n_steps} open-loop steps from a {prefix_len}-step "
               f"prefix, MSE vs real continuation {mse:.5f}")
 

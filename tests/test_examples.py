@@ -356,3 +356,27 @@ def test_worldmodel_dream_open_loop():
                           prefix_len=32, n_steps=8)
     assert preds.shape == (2, 8, wm.OBS_DIM)
     assert np.isfinite(mse)
+
+
+def test_worldmodel_rope_and_int8_dream():
+    """--pos rope + --dream-int8 through the module seams: rope training
+    descends and the quantized dream returns finite open-loop MSE."""
+    wm = load_example("worldmodel/train_worldmodel.py")
+    rng = np.random.default_rng(5)
+
+    def batches():
+        for _ in range(6):
+            yield {"episode": jax.device_put(wm.simulate_episode(
+                rng, batch=4
+            ).astype(np.float16))}
+
+    state, losses = wm.train_on_episodes(
+        batches(), d_model=32, n_heads=2, n_layers=1, log_every=0,
+        pos_encoding="rope",
+    )
+    assert "pos" not in state.params
+    assert losses[-1] < losses[0]
+    preds, mse = wm.dream(state, wm.simulate_episode(rng, batch=2),
+                          prefix_len=32, n_steps=8, int8=True)
+    assert preds.shape == (2, 8, wm.OBS_DIM)
+    assert np.isfinite(mse)
