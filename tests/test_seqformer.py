@@ -600,3 +600,31 @@ def test_quantized_seqformer_tracks_float_and_decodes_consistently():
     np.testing.assert_allclose(
         np.asarray(got_roll), np.asarray(want), atol=1e-4, rtol=1e-4
     )
+
+
+def test_rollout_shards_over_batch_axis():
+    """Dreaming composes with data parallelism: a batch-sharded prefix
+    rolls out under jit on the mesh and matches the single-device
+    rollout (the scan + ring-cache machinery is batch-elementwise, so
+    dp sharding is a layout choice here too)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh({"data": 4})
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=5, d_model=32, n_heads=4,
+        n_layers=1, pos_encoding="rope",
+    )
+    prefix = jax.random.normal(jax.random.PRNGKey(1), (8, 6, 5),
+                               jnp.float32)
+
+    roll = jax.jit(lambda p, x: seqformer.rollout(
+        p, x, 5, compute_dtype=jnp.float32, cache_dtype=jnp.float32,
+    ))
+    want = roll(params, prefix)
+    sharded_prefix = jax.device_put(
+        prefix, NamedSharding(mesh, P("data", None, None))
+    )
+    got = roll(params, sharded_prefix)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )
