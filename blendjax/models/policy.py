@@ -94,3 +94,76 @@ def reinforce_loss(params, obs, actions, returns, continuous=False):
     advantage = returns - returns.mean()
     advantage = advantage / (returns.std() + 1e-6)
     return -jnp.mean(logp * jax.lax.stop_gradient(advantage))
+
+
+def value_init(key, obs_dim, hidden=(64, 64)):
+    """MLP state-value params (critic): the policy trunk with a
+    1-output head — one source of truth for the architecture."""
+    return init(key, obs_dim, 1, hidden=hidden)
+
+
+def value_apply(params, obs):
+    return logits(params, obs)[..., 0]
+
+
+def gae(rewards, values, last_values, dones, gamma=0.99, lam=0.95):
+    """Generalized advantage estimation over a (T, N) rollout.
+
+    ``values`` (T, N) are V(s_t) along the rollout, ``last_values`` (N,)
+    is V(s_T) bootstrapping the tail; episode boundaries cut both the
+    bootstrap and the trace.  Returns (advantages, value_targets), each
+    (T, N); jittable via ``lax.scan``."""
+    nd = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], last_values[None]], axis=0)
+    deltas = rewards + gamma * next_values * nd - values
+
+    def step(carry, inp):
+        delta, mask = inp
+        carry = delta + gamma * lam * mask * carry
+        return carry, carry
+
+    _, rev = jax.lax.scan(
+        step, jnp.zeros(rewards.shape[1]), (deltas[::-1], nd[::-1])
+    )
+    adv = rev[::-1]
+    return adv, adv + values
+
+
+def ppo_loss(actor, critic, batch, clip_eps=0.2, vf_coef=0.5,
+             ent_coef=0.01, continuous=False):
+    """Clipped-surrogate PPO objective + value MSE + entropy bonus.
+
+    ``batch``: obs (B, D), actions (B,), logp_old (B,), advantages (B,)
+    (normalized here), targets (B,), optional mask (B,) — zero weight
+    for fabricated transitions (an autoresetting pool's reset step
+    records a sampled-but-never-executed action; see
+    ``examples/control/train_ppo.py``).  Returns the combined scalar.
+    """
+    obs, actions = batch["obs"], batch["actions"]
+    w = batch.get("mask")
+    if w is None:
+        w = jnp.ones(actions.shape[0], jnp.float32)
+    wsum = jnp.maximum(w.sum(), 1.0)
+
+    def wmean(x):
+        return (w * x).sum() / wsum
+
+    if continuous:
+        logp = gaussian_log_prob(actor, obs, actions)
+    else:
+        logp = categorical_log_prob(actor, obs, actions)
+    adv = batch["advantages"]
+    mu = wmean(adv)
+    std = jnp.sqrt(wmean((adv - mu) ** 2))
+    adv = (adv - mu) / (std + 1e-6)
+    ratio = jnp.exp(logp - batch["logp_old"])
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+    policy_loss = -wmean(jnp.minimum(ratio * adv, clipped * adv))
+    v = value_apply(critic, obs)
+    value_loss = wmean((v - batch["targets"]) ** 2)
+    if continuous:
+        ent = jnp.sum(actor["log_std"] + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+    else:
+        lp = jax.nn.log_softmax(logits(actor, obs))
+        ent = -wmean(jnp.sum(jnp.exp(lp) * lp, axis=-1))
+    return policy_loss + vf_coef * value_loss - ent_coef * ent
