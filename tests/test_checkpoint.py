@@ -134,3 +134,38 @@ def test_manager_ignores_interrupted_tmp_saves(tmp_path):
     # a further save retains real steps, not the phantom
     mgr.save(12, state)
     assert mgr.all_steps() == [5, 12]
+
+
+def test_quantized_and_rope_pytrees_roundtrip(tmp_path):
+    """The new param formats survive checkpointing: int8 weight dicts
+    (quantized models) keep their dtypes, and a rope model's ABSENT pos
+    table (the marker the forward dispatches on) stays absent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from blendjax.models import seqformer
+    from blendjax.ops.quant import quantize_seqformer
+    from blendjax.utils.checkpoint import load_pytree, save_pytree
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=4, d_model=32, n_heads=4,
+        n_layers=1, pos_encoding="rope",
+    )
+    qparams = quantize_seqformer(jax.device_get(params))
+    path = tmp_path / "q.npz"
+    save_pytree(path, qparams)
+    restored = load_pytree(path, jax.tree.map(jnp.zeros_like, qparams))
+    assert "pos" not in restored
+    wq = restored["blocks"][0]["wq"]
+    assert wq["w_q"].dtype == jnp.int8
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        restored, qparams,
+    )
+    # the restored pytree actually runs the quantized forward
+    obs = jnp.zeros((1, 8, 4), jnp.float32)
+    out = seqformer.apply(restored, obs, compute_dtype=jnp.float32)
+    assert out.shape == (1, 8, 4)
