@@ -445,7 +445,8 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
     # the train-step-level ratios, which supersede them in the headline
     kflash = pick("kernel_flash")
     kff = pick("kernel_flash_vs_full")
-    if kflash or kff:
+    kwin = pick("kernel_flash_windowed")
+    if kflash or kff or kwin:
         ka = {}
         if kflash:
             ka["flash_step_ms"] = round(
@@ -457,6 +458,11 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
                       "flash_over_full_kernel"):
                 if k in kff:
                     ka[k] = kff[k]
+        if kwin:
+            for k in ("window", "windowed_step_ms",
+                      "windowed_over_flash"):
+                if k in kwin:
+                    ka[k] = kwin[k]
         extras["kernel_attn"] = ka
     kint8 = pick("int8_infer")
     if kint8:

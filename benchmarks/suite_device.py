@@ -618,6 +618,36 @@ def phase_kernel_microverdicts(args, budget, tag):
         except Exception as e:  # noqa: BLE001
             note(f"kernel_full_attn failed: {type(e).__name__}: {e}")
 
+    if flash_ms is not None and T >= 256 and budget.has(
+            45, "kernel_flash_windowed"):
+        # the sliding-window kernel's on-chip witness (AFTER the owed
+        # flash<=full verdict — this exhibit must not starve it in a
+        # short window): same shapes, W =
+        # T/4 — the shrunk O(T*W) grids should beat plain causal by
+        # roughly the visible-area ratio; the measured number ships
+        progress("kernel_flash_windowed_compile")
+        try:
+            win = T // 4
+            wflash = make_flash_attention(
+                causal=True, block_q="auto", block_kv="auto",
+                interpret=interpret, window=win,
+            )
+            stats, _ = measure_step_time(
+                attn_step_fn(wflash), qkv, None, budget,
+                windows=args.windows,
+            )
+            wms = stats["step_s"] * 1e3
+            emit({"phase": "kernel_flash_windowed", "window": win,
+                  "windowed_step_ms": round(wms, 3),
+                  "flash_step_ms": round(flash_ms, 3),
+                  "windowed_over_flash": round(
+                      wms / max(flash_ms, 1e-9), 4
+                  ),
+                  "seq_len": T, "heads": H, "head_dim": D, "batch": B,
+                  **tag})
+        except Exception as e:  # noqa: BLE001
+            note(f"kernel_flash_windowed failed: {type(e).__name__}: {e}")
+
     def moe_step_fn(apply_fn):
         def loss(x, p):
             return (apply_fn(p, x).astype(jnp.float32) ** 2).mean()

@@ -259,6 +259,11 @@ def test_kernel_microverdicts_carry_and_headline_fallback():
         "flash_step_ms": 12.0, "full_step_ms": 19.0,
         "flash_over_full_kernel": 0.6316,
     }
+    phases["kernel_flash_windowed"] = {
+        "phase": "kernel_flash_windowed", "platform": "tpu",
+        "window": 128, "windowed_step_ms": 4.1, "flash_step_ms": 12.0,
+        "windowed_over_flash": 0.3417,
+    }
     phases["kernel_topk_vs_dense"] = {
         "phase": "kernel_topk_vs_dense", "platform": "tpu",
         "topk_step_ms": 8.0, "dense_step_ms": 21.0,
@@ -267,6 +272,8 @@ def test_kernel_microverdicts_carry_and_headline_fallback():
     out = assemble(phases, rl=None)
     assert out["kernel_attn"]["flash_over_full_kernel"] == 0.6316
     assert out["kernel_attn"]["flash_compiled"] is True
+    assert out["kernel_attn"]["windowed_over_flash"] == 0.3417
+    assert out["kernel_attn"]["window"] == 128
     assert out["kernel_moe"]["topk_over_dense_kernel"] == 0.381
 
     # train-step ratios present: the headline keeps the stronger claim

@@ -197,12 +197,41 @@ def test_phase_kernel_microverdicts_banks_incrementally(capsys):
     assert ktd["topk_over_dense_kernel"] > 0
     assert ktd["experts"] == 4 and ktd["top_k"] == 2
 
+    # the windowed-flash witness needs T >= 256: absent at this size
+    assert "kernel_flash_windowed" not in by_phase
+
     # operator skip flags suppress the matching halves (and their input
     # tensors are then never built)
     args.skip_seqformer = True
     args.skip_moe = True
     phase_kernel_microverdicts(args, Budget(600), tag)
     assert capsys.readouterr().out == ""
+
+
+def test_phase_kernel_microverdicts_windowed_witness(capsys):
+    """At T >= 256 the phase also times the sliding-window kernel at
+    W = T/4 and ships the windowed/flash ratio."""
+    import argparse
+    import json
+
+    from benchmarks.suite_device import phase_kernel_microverdicts
+
+    args = argparse.Namespace(
+        seq_len=257, n_heads=2, d_model=32, windows=1,
+        moe_experts=4, moe_topk=2, moe_dispatch="sort",
+        skip_seqformer=False, skip_moe=True,
+    )
+    phase_kernel_microverdicts(
+        args, Budget(900), {"platform": "cpu", "config": "small"}
+    )
+    lines = [json.loads(s) for s in
+             capsys.readouterr().out.strip().splitlines()]
+    rec = [l for l in lines if l["phase"] == "kernel_flash_windowed"]
+    assert len(rec) == 1
+    rec = rec[0]
+    assert rec["window"] == 64
+    assert rec["windowed_over_flash"] > 0
+    assert rec["windowed_step_ms"] > 0
 
 
 def test_apply_config_n_layers_sentinel():
