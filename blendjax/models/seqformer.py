@@ -408,6 +408,16 @@ def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
                 "as length="
             )
         length = params["pos"].shape[0]
+    elif "pos" in params and length > params["pos"].shape[0]:
+        # decode_step indexes the pos table with a traced position;
+        # lax.dynamic_index_in_dim CLAMPS out-of-bounds, so steps past
+        # max_len would silently reuse the last embedding — reject the
+        # intent here, statically
+        raise ValueError(
+            f"cache length {length} exceeds the learned position "
+            f"table ({params['pos'].shape[0]}); use pos_encoding='rope' "
+            "for longer horizons"
+        )
     caches = {"k": [], "v": [], "pos": jnp.asarray(0, jnp.int32)}
     for blk in params["blocks"]:
         wk = blk["wk"]
@@ -430,15 +440,24 @@ def _attn_one(q, kc, vc, pos, scale, window=None):
     heads."""
     b, c, h_kv, dh = kc.shape
     h = q.shape[1]
-    if h_kv != h:
-        kc = jnp.repeat(kc, h // h_kv, axis=2)
-        vc = jnp.repeat(vc, h // h_kv, axis=2)
-    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
-                   kc.astype(jnp.float32)) * scale
     slot_pos = pos - ((pos - jnp.arange(c)) % c)
     keep = slot_pos >= 0  # never-written slots sit at negative positions
     if window is not None:
         keep = jnp.logical_and(keep, slot_pos > pos - window)
+    if h_kv != h:
+        # grouped einsum straight against the un-repeated cache —
+        # materializing a repeated copy per decode step would pay
+        # exactly the KV bandwidth GQA exists to avoid
+        g = h // h_kv
+        qg = q.reshape(b, h_kv, g, dh).astype(jnp.float32)
+        s = jnp.einsum("bkgd,blkd->bkgl", qg,
+                       kc.astype(jnp.float32)) * scale
+        s = jnp.where(keep[None, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bkgl,blkd->bkgd", p, vc.astype(jnp.float32))
+        return out.reshape(b, h, dh)
+    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * scale
     s = jnp.where(keep[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhl,blhd->bhd", p, vc.astype(jnp.float32))

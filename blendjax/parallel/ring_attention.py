@@ -143,6 +143,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    if k.shape[2] != q.shape[2]:
+        raise ValueError(
+            "ring does not support GQA (kv heads != q heads); "
+            "use impl='ulysses' or repeat kv heads before the ring"
+        )
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -797,6 +802,15 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     masking needs no cross-shard machinery here).
     """
     inner = inner_attn or full_attention
+    n = lax.psum(1, axis_name)
+    for name, arr in (("q", q), ("k", k), ("v", v)):
+        if arr.shape[2] % n:
+            raise ValueError(
+                f"ulysses needs {name}'s head count ({arr.shape[2]}) "
+                f"divisible by the sequence axis size ({n}); under GQA "
+                "pick n_kv_heads as a multiple of the axis, or repeat "
+                "kv heads upstream"
+            )
     kwargs = dict(causal=causal, scale=scale)
     if window is not None:
         # only passed when set, so inner_attn closures predating the

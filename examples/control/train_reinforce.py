@@ -119,7 +119,14 @@ def train(
         flat_ret = returns.reshape(-1)
 
         state, loss = update(state, flat_obs, flat_act, flat_ret)
-        mean_ep = float(rewards.sum() / jnp.maximum(dones.sum(), 1))
+        finished = float(dones.sum())
+        if finished:
+            mean_ep = float(rewards.sum()) / finished
+        else:
+            # no episode closed this horizon: report reward per LANE so
+            # the log stays comparable instead of printing the raw total
+            # as "reward/episode"
+            mean_ep = float(rewards.sum()) / rewards.shape[1]
         returns_log.append(mean_ep)
         if log_every and (it + 1) % log_every == 0:
             print(f"iter {it + 1}: loss {float(loss):.4f} reward/episode {mean_ep:.1f}")
