@@ -3,10 +3,13 @@
 - ``detector``      — keypoint CNN for the datagen workload (flagship).
 - ``discriminator`` — real/fake image scorer for densityopt.
 - ``probmodel``     — log-normal sim-parameter model + score-function grads.
-- ``policy``        — MLP policies + REINFORCE for the control workload.
+- ``policy``        — MLP policies + REINFORCE/PPO (critic, GAE,
+                      clipped surrogate) for the control workload.
 - ``seqformer``     — causal temporal transformer (world-model) over
                       episode sequences; long-context flagship (ring/
-                      Ulysses sequence parallel, optional MoE).
+                      Ulysses sequence parallel, sliding window, GQA,
+                      learned or rotary positions, optional MoE;
+                      KV-cache ``rollout`` for open-loop dreaming).
 - ``train``         — TrainState + jitted/donated train-step builders.
 """
 
