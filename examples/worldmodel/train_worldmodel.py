@@ -171,7 +171,7 @@ def sharded_transform(batch):
 
 
 def make_sharded_trainer(mesh_shape, attn_impl, d_model=128, n_heads=4,
-                         n_layers=2, window=None):
+                         n_layers=2, window=None, pos_encoding="learned"):
     """(state, step, batch_sharding) for dp x sp x tp training.
 
     Built BEFORE the stream so JaxStream can place batches directly on
@@ -185,6 +185,7 @@ def make_sharded_trainer(mesh_shape, attn_impl, d_model=128, n_heads=4,
     params = seqformer.init(
         jax.random.PRNGKey(0), obs_dim=OBS_DIM, d_model=d_model,
         n_heads=n_heads, n_layers=n_layers, max_len=T,
+        pos_encoding=pos_encoding,
     )
     init_sharded, step, batch_sharding = make_seqformer_train_step(
         optax.adam(3e-4), mesh, attn_impl=attn_impl, attn_window=window
@@ -215,8 +216,9 @@ def main():
     ap.add_argument("--pos", choices=["learned", "rope"],
                     default="learned",
                     help="position encoding (rope: relative positions, "
-                         "dream horizons unbounded by max_len; "
-                         "single-device path only here)")
+                         "dream horizons unbounded by max_len; works on "
+                         "both the single-device and --mesh paths — the "
+                         "rotation happens before the attention seam)")
     ap.add_argument("--dream-int8", action="store_true",
                     help="quantize the trained model (w8a8) before "
                          "dreaming — the bandwidth-bound decode phase "
@@ -242,15 +244,10 @@ def main():
         if attn not in PARALLEL_ATTN:
             ap.error(f"--mesh needs a parallel --attn {PARALLEL_ATTN}, "
                      f"got {attn!r}")
-        if args.pos == "rope":
-            # silently training learned positions under a --pos rope
-            # flag would invalidate whatever comparison the user thinks
-            # they ran (same policy as the attn-name validation above)
-            ap.error("--pos rope is single-device-path only here; drop "
-                     "--mesh or --pos")
         mesh_shape = tuple(int(x) for x in args.mesh.split(","))
         state, step, batch_sharding = make_sharded_trainer(
-            mesh_shape, attn, window=args.window
+            mesh_shape, attn, window=args.window,
+            pos_encoding=args.pos,
         )
         stream_kwargs = dict(
             transform=sharded_transform, sharding=batch_sharding
@@ -284,7 +281,7 @@ def main():
     if args.dream > 0:
         rng = np.random.default_rng(123)
         prefix_len = T // 2
-        if args.pos == "rope" and not args.mesh:
+        if args.pos == "rope":
             # rope has no table bound: honor the requested horizon by
             # simulating a long enough held-out episode to score it
             n_steps = args.dream

@@ -255,8 +255,8 @@ def test_worldmodel_flash_attn_option_runs():
 import pytest
 
 
-@pytest.mark.parametrize("window", [None, 20])
-def test_worldmodel_train_sharded_ring_flash(window):
+@pytest.mark.parametrize("window,pos", [(None, "learned"), (20, "rope")])
+def test_worldmodel_train_sharded_ring_flash(window, pos):
     """The example's --mesh path: dp x sp x tp with the flash kernel
     fused into ring attention (plain and sliding-window), batches
     placed directly on the mesh."""
@@ -264,7 +264,7 @@ def test_worldmodel_train_sharded_ring_flash(window):
     rng = np.random.default_rng(1)
     state, step, batch_sharding = wm.make_sharded_trainer(
         (2, 2, 2), "ring_flash", d_model=32, n_heads=4, n_layers=1,
-        window=window,
+        window=window, pos_encoding=pos,
     )
 
     def batches():
