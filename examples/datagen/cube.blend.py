@@ -39,6 +39,11 @@ def main():
 
     cube, _ = build_scene()
     cam = btb.Camera()
+    # aim at the origin: a procedurally added camera looks straight down
+    # -Z by default and would frame empty space (the reference's
+    # pre-authored cube.blend ships an aimed camera; a procedural scene
+    # must aim its own)
+    cam.look_at(look_at=(0.0, 0.0, 0.0), look_from=(0.0, -8.0, 2.0))
     off = btb.OffScreenRenderer(camera=cam, mode="rgb")
     off.set_render_style(shading="RENDERED", overlays=False)
     pub = btb.DataPublisher(args.btsockets["DATA"], btid=args.btid)
@@ -55,7 +60,14 @@ def main():
 
     anim.pre_frame.add(randomize)
     anim.post_frame.add(publish, anim)
-    anim.play(frame_range=(0, 100), num_episodes=-1)
+    # --background has no window-manager player: use the blocking
+    # frame_set loop (same handler sequence; the offscreen render then
+    # runs in frame_change_post instead of a POST_PIXEL draw handler)
+    anim.play(
+        frame_range=(0, 100), num_episodes=-1,
+        use_animation=not getattr(bpy.app, "background", False),
+        use_offline_render=not getattr(bpy.app, "background", False),
+    )
 
 
 main()

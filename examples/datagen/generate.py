@@ -65,6 +65,26 @@ def train_on_stream(batches, state=None, log_every=8):
     return state, losses
 
 
+def infer_int8(state, raw_frames):
+    """w8a8 inference on a trained detector: quantize once, run the
+    int8 forward on decoded frames (blendjax.ops.quant; half the weight
+    bytes, int8 MXU operands).  Returns (N, K, 2) keypoints."""
+    from blendjax.ops.quant import quantize_detector
+
+    qparams = quantize_detector(state.params)
+    images = decode_frames(raw_frames, dtype=jax.numpy.float32)
+    return _jit_int8_apply(qparams, images)
+
+
+def _int8_apply(qparams, images):
+    from blendjax.ops.quant import detector_apply_int8
+
+    return detector_apply_int8(qparams, images)
+
+
+_jit_int8_apply = jax.jit(_int8_apply)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--record", metavar="PREFIX", help="record while streaming")
@@ -73,6 +93,13 @@ def main():
     ap.add_argument("--items", type=int, default=512)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--background", action="store_true",
+                    help="run Blender headless (the producer then uses "
+                         "the blocking frame loop; offscreen GL must "
+                         "be available, e.g. xvfb or the fake stack)")
+    ap.add_argument("--infer-int8", action="store_true",
+                    help="after training, run one quantized (w8a8) "
+                         "inference batch on the live stream")
     args = ap.parse_args()
 
     mesh = data_mesh()
@@ -96,6 +123,7 @@ def main():
         script=str(SCRIPT),
         num_instances=args.instances,
         named_sockets=["DATA"],
+        background=args.background,
     ) as bl:
         ds = btt.RemoteIterableDataset(
             bl.launch_info.addresses["DATA"],
@@ -106,7 +134,17 @@ def main():
         with btt.JaxStream(
             ds, batch_size=args.batch, num_workers=args.workers, sharding=sharding
         ) as stream:
-            train_on_stream(iter(stream))
+            it = iter(stream)
+            # reserve the inference batch BEFORE training: training
+            # drains the finite stream completely
+            hold = next(it, None) if args.infer_int8 else None
+            state, _ = train_on_stream(it)
+            if hold is not None:
+                xy = infer_int8(state, hold["image"])
+                print(f"int8 inference: {xy.shape[0]} frames -> "
+                      f"keypoints {tuple(xy.shape[1:])}")
+            elif args.infer_int8:
+                print("int8 inference SKIPPED: stream yielded no batch")
         print("stage timing:", stream.timer.summary())
 
 

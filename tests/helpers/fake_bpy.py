@@ -248,18 +248,34 @@ class FakeCameraObject:
 
 
 class FakeObject:
-    """Generic posed object (empties, primitive meshes): location +
-    XYZ-euler rotation, optional ``parent`` composed into
+    """Generic posed object (empties, primitive meshes, lights):
+    location + XYZ-euler rotation, optional ``parent`` composed into
     ``matrix_world`` the way Blender's depsgraph does for simple
     parenting (no inverse-parent correction — objects here are created
     at the origin before parenting, matching the procedural-producer
-    usage this fake serves)."""
+    usage this fake serves).  With ``vertices`` it also carries mesh
+    data (``data.vertices``, ``bound_box``, identity
+    ``evaluated_get``), so camera annotation helpers
+    (``object_to_pixel``) work on it."""
 
-    def __init__(self, location=(0.0, 0.0, 0.0)):
+    def __init__(self, location=(0.0, 0.0, 0.0), vertices=None):
         self.location = Vector(location)
         self.rotation_euler = (0.0, 0.0, 0.0)
         self.parent = None
         self.name = ""
+        if vertices is not None:
+            self.data = types.SimpleNamespace(vertices=[
+                types.SimpleNamespace(co=Vector(v)) for v in vertices
+            ])
+            vs = np.asarray(vertices, float)
+            lo, hi = vs.min(0), vs.max(0)
+            self.bound_box = [
+                (xx, yy, zz) for xx in (lo[0], hi[0])
+                for yy in (lo[1], hi[1]) for zz in (lo[2], hi[2])
+            ]
+
+    def evaluated_get(self, depsgraph):
+        return self
 
     @property
     def matrix_world(self):
@@ -454,11 +470,31 @@ class _Ops:
                 FakeObject(location)
             ),
         )
+        def _posed(obj, rotation):
+            if rotation is not None:
+                obj.rotation_euler = tuple(rotation)
+            return self._add(obj)
+
+        self.object.camera_add = (
+            lambda location=(0.0, 0.0, 0.0), rotation=None, **kw: _posed(
+                FakeCameraObject(location=location), rotation
+            )
+        )
+        self.object.light_add = (
+            lambda type=None, location=(0.0, 0.0, 0.0), rotation=None,
+            **kw: _posed(FakeObject(location), rotation)
+        )
         self.mesh = types.SimpleNamespace(
             primitive_uv_sphere_add=lambda radius=1.0,
             location=(0.0, 0.0, 0.0), **kw: self._add(FakeObject(location)),
             primitive_cube_add=lambda size=2.0,
-            location=(0.0, 0.0, 0.0), **kw: self._add(FakeObject(location)),
+            location=(0.0, 0.0, 0.0), **kw: self._add(FakeObject(
+                location,
+                vertices=[
+                    (sx * size / 2, sy * size / 2, sz * size / 2)
+                    for sx in (-1, 1) for sy in (-1, 1) for sz in (-1, 1)
+                ],
+            )),
         )
 
     def _add(self, obj):
