@@ -61,12 +61,12 @@ def main():
     anim.pre_frame.add(randomize)
     anim.post_frame.add(publish, anim)
     # --background has no window-manager player: use the blocking
-    # frame_set loop (same handler sequence; the offscreen render then
-    # runs in frame_change_post instead of a POST_PIXEL draw handler)
+    # frame_set loop there (the blocking path routes post_frame through
+    # frame_change_post and never consults use_offline_render; the UI
+    # path keeps the default POST_PIXEL draw-handler routing for GL)
     anim.play(
         frame_range=(0, 100), num_episodes=-1,
         use_animation=not getattr(bpy.app, "background", False),
-        use_offline_render=not getattr(bpy.app, "background", False),
     )
 
 
