@@ -136,6 +136,10 @@ def main():
     ap.add_argument("--iterations", type=int, default=100)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--target", type=float, nargs=2, default=[5.0, 5.0])
+    ap.add_argument("--background", action="store_true",
+                    help="run Blender headless (producers then use the "
+                         "blocking frame loop; offscreen GL must be "
+                         "available, e.g. the fake stack)")
     args = ap.parse_args()
 
     with btt.BlenderLauncher(
@@ -143,6 +147,7 @@ def main():
         script=str(SCRIPT),
         num_instances=args.instances,
         named_sockets=["DATA", "CTRL"],
+        background=args.background,
     ) as bl:
         ds = btt.RemoteIterableDataset(
             bl.launch_info.addresses["DATA"], max_items=10**9, timeoutms=30000

@@ -72,6 +72,9 @@ def main():
     build_scene()
     obj = make_mesh(3.0, 3.0)
     cam = btb.Camera()
+    # aim at the origin: a procedurally added camera looks down -Z and
+    # would frame empty space (same class of bug the datagen cube had)
+    cam.look_at(look_at=(0.0, 0.0, 0.0), look_from=(0.0, -6.0, 0.0))
     off = btb.OffScreenRenderer(camera=cam, mode="rgb")
     pub = btb.DataPublisher(args.btsockets["DATA"], btid=args.btid)
     duplex = btb.DuplexChannel(args.btsockets["CTRL"], btid=args.btid)
@@ -93,7 +96,13 @@ def main():
 
     anim.pre_frame.add(apply_params)
     anim.post_frame.add(publish)
-    anim.play(frame_range=(0, 10000), num_episodes=-1)
+    # --background has no window-manager player: use the blocking
+    # frame_set loop there (the fake-Blender stack runs this headless;
+    # real offscreen GL needs a windowed Blender)
+    anim.play(
+        frame_range=(0, 10000), num_episodes=-1,
+        use_animation=not getattr(bpy.app, "background", False),
+    )
 
 
 main()

@@ -517,6 +517,23 @@ class _PropCollection(list):
         list.remove(self, obj)
 
 
+class FakeMesh:
+    """Stands in for ``bpy.types.Mesh`` as procedural producers use it:
+    ``from_pydata`` + ``update`` + vertex access."""
+
+    def __init__(self, name=""):
+        self.name = name
+        self.vertices = []
+
+    def from_pydata(self, verts, edges, faces):
+        self.vertices = [
+            types.SimpleNamespace(co=Vector(v)) for v in verts
+        ]
+
+    def update(self):
+        pass
+
+
 class FakeBpy(types.ModuleType):
     """Install with ``install()`` before importing blendjax.btb.animation."""
 
@@ -545,21 +562,36 @@ class FakeBpy(types.ModuleType):
         objects = _PropCollection()
 
         def _new_object(name, data):
-            obj = FakeCameraObject(location=(0.0, 0.0, 0.0), data=data)
+            # camera data makes a camera object (the offscreen/camera
+            # test path); anything else (e.g. a FakeMesh) a posed object
+            if isinstance(data, FakeCameraData):
+                obj = FakeCameraObject(location=(0.0, 0.0, 0.0), data=data)
+            else:
+                obj = FakeObject()
+                obj.data = data
             obj.name = name
             return obj
 
+        meshes = _PropCollection()
+
+        def _new_mesh(name):
+            mesh = FakeMesh(name)
+            meshes.append(mesh)
+            return mesh
+
         self.data = types.SimpleNamespace(
             objects=objects,
-            meshes=_PropCollection(),
+            meshes=meshes,
             cameras=types.SimpleNamespace(
                 new=lambda name: FakeCameraData()
             ),
         )
+        self.data.meshes.new = _new_mesh
         self.data.objects.new = _new_object
         scene.collection = types.SimpleNamespace(
             objects=types.SimpleNamespace(link=objects.append)
         )
+        self.context.collection = scene.collection
         self.context.view_layer.update = lambda: None
         self.ops = _Ops(self)
         self._animation_running = False

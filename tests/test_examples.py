@@ -462,3 +462,41 @@ def test_datagen_cube_producer_streams_annotated_frames(monkeypatch):
         # the camera is AIMED: every corner projects inside the frame
         assert (item["xy"][:, 0] >= 0).all() and (item["xy"][:, 0] <= 640).all()
         assert (item["xy"][:, 1] >= 0).all() and (item["xy"][:, 1] <= 480).all()
+
+
+def test_densityopt_supershape_producer_duplex_roundtrip(monkeypatch):
+    """The densityopt PRODUCER half end-to-end through the real
+    launcher on the fake stack: supershape.blend.py builds its
+    procedural mesh, receives shape params over the duplex channel,
+    regenerates the mesh, and publishes {image, shape_id} correlated
+    to the request — the reference's bi-directional flow."""
+    import os
+
+    from blendjax.btt.duplex import DuplexChannel
+    from blendjax.btt.launcher import BlenderLauncher
+    from helpers import FAKE_BLENDER
+
+    monkeypatch.setenv("BLENDJAX_BLENDER", FAKE_BLENDER)
+    monkeypatch.setenv("BLENDJAX_FAKE_BPY", "1")
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "densityopt", "supershape.blend.py",
+    )
+    with BlenderLauncher(
+        scene="", script=script, num_instances=1,
+        named_sockets=["DATA", "CTRL"], start_port=13591,
+        background=True,
+    ) as bl:
+        duplex = DuplexChannel(bl.launch_info.addresses["CTRL"][0])
+        try:
+            duplex.send(shape_params=(4.0, 6.0), shape_id=7)
+            items = list(RemoteIterableDataset(
+                bl.launch_info.addresses["DATA"], max_items=1,
+                timeoutms=30000,
+            ))
+        finally:
+            duplex.close()
+    assert len(items) == 1
+    assert items[0]["shape_id"] == 7
+    assert items[0]["image"].shape == (128, 128, 3)
+    assert items[0]["image"].dtype == np.uint8
