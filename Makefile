@@ -6,8 +6,10 @@
 # headless hosts get a GL context via scripts/blender_headless.sh.
 
 PYTHON ?= python
+# tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
+SHELL := /bin/bash
 
-.PHONY: test blender-tests tpu-tests bench dryrun
+.PHONY: test tier1 blender-tests tpu-tests bench dryrun
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -15,6 +17,19 @@ test:
 	# (conftest.py strips it for child processes; the pytest interpreter
 	# itself must start without it)
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) -m pytest tests/ -q
+
+# The ROADMAP tier-1 verify command, verbatim: CPU-forced, non-slow
+# subset with the driver's DOTS_PASSED accounting.  This is the gate a
+# PR must keep no worse than the seed.
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+		| tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
+		| tr -cd . | wc -c); \
+	exit $$rc
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.

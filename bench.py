@@ -194,6 +194,16 @@ def probe_log_summary(path=None):
     return out
 
 
+def feed_bound_phase(seconds=3.0):
+    """Measure the feed ceiling (batch assembly with a trivial train
+    step), legacy collate vs arena-pooled scatter — jax-free, in-process,
+    so the number lands even when the accelerator (or its tunnel) is
+    down.  See benchmarks/feed_bound.py."""
+    from benchmarks.feed_bound import measure
+
+    return measure(seconds=seconds)
+
+
 def main():
     sys.path.insert(0, HERE)
     try:
@@ -211,6 +221,14 @@ def main():
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
     t_start = time.monotonic()
+    # feed-bound mode first: cheap (~20 s), jax-free, and measures the
+    # assembly ceiling the wire-efficiency story needs (BENCH_r05 flagged
+    # wire_efficiency_meaningful: false because no mode observed the feed)
+    feed_bound = None
+    try:
+        feed_bound = feed_bound_phase()
+    except Exception as e:  # noqa: BLE001 - the suite phases still run
+        sys.stderr.write(f"feed_bound phase failed: {type(e).__name__}: {e}\n")
     cores = os.cpu_count() or 1
     instances = 4 if cores >= 4 else 1
     workers = 4 if cores >= 4 else 1
@@ -270,7 +288,8 @@ def main():
         )
         rl_physics = rl_lines[-1] if rl_lines else None
 
-    out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback)
+    out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
+                   feed_bound=feed_bound)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -313,6 +332,7 @@ HEADLINE_ABBREV = (
 #: partial/degraded markers are never dropped.
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
+    ("feed_arena_x",),
     ("attn",),
     ("wire_limit", "wire_eff", "wire_eff_ok"),
     ("duty", "duty_cycle_invalid", "seq_duty", "seq_duty_invalid"),
@@ -328,6 +348,10 @@ def headline(out):
     for k, short in HEADLINE_ABBREV:
         if k in out:
             line[short] = out[k]
+    fb = out.get("feed_bound")
+    if fb and fb.get("arena_over_legacy") is not None:
+        # arena assembly speedup over legacy collate at the feed ceiling
+        line["feed_arena_x"] = fb["arena_over_legacy"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -378,12 +402,19 @@ def headline(out):
     return line
 
 
-def assemble(phases, rl=None, rl_physics=None, host_fallback=None):
+def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
+             feed_bound=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
     (tests/test_bench_assembly.py)."""
     extras = {"includes_rendering": False}
+    if feed_bound:
+        # the feed ceiling, legacy vs arena assembly (trivial train step,
+        # jax-free) — including the arena stage timings (arena_wait /
+        # scatter / recycle), so the copy-elimination win is measurable
+        # in the artifact rather than asserted
+        extras["feed_bound"] = feed_bound
 
     def pick(name):
         # prefer the accelerator child's phase; fall back to the cpu

@@ -22,6 +22,8 @@ _LAZY = {
     "DuplexChannel": ("blendjax.btt.duplex", "DuplexChannel"),
     "BatchLoader": ("blendjax.btt.loader", "BatchLoader"),
     "collate": ("blendjax.btt.collate", "collate"),
+    "ArenaPool": ("blendjax.btt.arena", "ArenaPool"),
+    "ArenaBatch": ("blendjax.btt.arena", "ArenaBatch"),
     "device_prefetch": ("blendjax.btt.prefetch", "device_prefetch"),
     "JaxStream": ("blendjax.btt.prefetch", "JaxStream"),
     "RemoteEnv": ("blendjax.btt.env", "RemoteEnv"),
@@ -38,6 +40,7 @@ _LAZY_MODULES = (
     "launcher",
     "finder",
     "launch_info",
+    "arena",
     "dataset",
     "file",
     "duplex",

@@ -4,6 +4,14 @@ plain numpy pytrees ready for ``jax.device_put`` (no torch dependency).
 Rules: a list of dicts becomes a dict of stacked leaves; ndarrays stack on a
 new leading axis; numeric scalars become 1-D arrays; strings/bytes and
 ragged leaves stay Python lists.
+
+Role in the arena pipeline: the zero-copy batch assembly
+(``_BatchBuilder`` in :mod:`blendjax.btt.dataset`) scatters fixed-shape
+array leaves straight into recycled batch buffers and routes everything
+it cannot scatter — ragged leaves, mixed-dtype columns, non-array values,
+compat-pickle containers — through :func:`collate`, so these rules remain
+the single source of truth for batch semantics on BOTH paths (parity is
+locked by ``tests/test_arena.py``).
 """
 
 from __future__ import annotations

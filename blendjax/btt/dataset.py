@@ -18,6 +18,8 @@ Sharding semantics match the reference: each worker yields
 from __future__ import annotations
 
 import bisect
+import numbers
+import pickle
 import sys
 import time
 from contextlib import ExitStack
@@ -149,19 +151,8 @@ class RemoteIterableDataset:
                         )
                     )
                 for _ in range(count):
-                    waited = 0
-                    slice_ms = 100 if stop_event is not None else self.timeoutms
-                    while True:
-                        if stop_event is not None and stop_event.is_set():
-                            return
-                        if poller.poll(min(slice_ms, self.timeoutms)):
-                            break
-                        waited += slice_ms
-                        if waited >= self.timeoutms:
-                            raise TimeoutError(
-                                f"No message within {self.timeoutms} ms from "
-                                f"{self.addresses}"
-                            )
+                    if not self._poll_message(poller, stop_event):
+                        return
                     if rec is not None:
                         frames = wire.recv_message_raw(socket)
                         rec.save_frames(frames)
@@ -171,6 +162,25 @@ class RemoteIterableDataset:
                     yield self._item(obj)
         finally:
             socket.close(0)
+
+    def _poll_message(self, poller, stop_event):
+        """Wait for the next message on the PULL socket: True when one is
+        ready, False when ``stop_event`` fired; raises TimeoutError after
+        ``timeoutms`` of silence.  Shared by the per-item and batched
+        ZMQ stream loops so the timeout/stop semantics cannot drift."""
+        waited = 0
+        slice_ms = 100 if stop_event is not None else self.timeoutms
+        while True:
+            if stop_event is not None and stop_event.is_set():
+                return False
+            if poller.poll(min(slice_ms, self.timeoutms)):
+                return True
+            waited += slice_ms
+            if waited >= self.timeoutms:
+                raise TimeoutError(
+                    f"No message within {self.timeoutms} ms from "
+                    f"{self.addresses}"
+                )
 
     def _shm_rotation(self, worker_id, num_workers, stop_event, consume, count):
         """Shared ring-rotation loop for the shm paths: opens this worker's
@@ -269,15 +279,14 @@ class RemoteIterableDataset:
         ``dataset.py:113-117``)."""
         return self.item_transform(item)
 
-    # -- batched zero-intermediate-copy path (shm transport) ---------------
+    # -- batched zero-intermediate-copy path (shm + zmq transports) --------
 
     def supports_batched_stream(self):
         """True when :meth:`stream_batches` can assemble batches straight
-        out of the shm arena (native transport, no recording, no per-item
-        transform)."""
+        from the wire frames (no recording, no per-item transform) —
+        both the native shm transport and the ZMQ fan-in qualify."""
         return (
             bool(self.addresses)
-            and all(a.startswith("shm://") for a in self.addresses)
             and self.record_path_prefix is None
             and self.item_transform is _identity
             and type(self)._item is RemoteIterableDataset._item
@@ -293,21 +302,38 @@ class RemoteIterableDataset:
         stop_event=None,
         drop_last=True,
         timer=None,
+        arena_pool=None,
     ):
         """Yield collated batches, bypassing per-item materialization.
 
-        On the shm transport each message's array payloads normally cost
-        two consumer-side copies: arena -> frame buffer
-        (``recv_frames``), then frame buffers -> batch (``collate``).
-        This path holds each ring record open just long enough to memcpy
-        its payloads **directly into preallocated batch buffers**
-        (``recv_frames_view`` + ``copy_into``, GIL released) — one copy,
-        no intermediate allocations.
+        Array payloads are scattered **directly into preallocated batch
+        buffers at their final batch offset** instead of the per-item
+        view + ``collate`` stack:
+
+        - shm transport: each ring record is held open just long enough
+          to memcpy its payloads into the batch buffers
+          (``recv_frames_view`` + ``copy_into``, GIL released) — one
+          copy, no intermediate allocations;
+        - ZMQ transport: raw-buffer frames are referenced until the
+          batch completes, then gathered per leaf in ONE GIL-released
+          native call (``gather_into``) straight into the batch buffer
+          — the ``np.frombuffer`` view + ``np.stack`` copy of the
+          legacy path disappears entirely.
+
+        ``arena_pool`` (an :class:`blendjax.btt.arena.ArenaPool`)
+        recycles the batch buffers themselves: batches are then yielded
+        as :class:`~blendjax.btt.arena.ArenaBatch` and the consumer
+        (normally the device prefetcher) recycles each arena once its
+        transfer completes — pool exhaustion backpressures the stream
+        (``arena_wait`` stage) instead of growing host memory.
 
         Falls back to ``stream()`` + collate when
-        :meth:`supports_batched_stream` is False.  Schema drift between
-        messages (changed shape/dtype for a key) degrades that key to the
-        generic collate rules instead of failing the stream.
+        :meth:`supports_batched_stream` is False (recording or per-item
+        transforms active).  Schema drift between messages (changed
+        shape/dtype for a key), ragged leaves, and compat-pickle
+        messages degrade per key to the generic collate rules instead
+        of failing the stream — existing producers keep working
+        unmodified.
         """
         from blendjax.btt.collate import collate as default_collate
 
@@ -336,7 +362,11 @@ class RemoteIterableDataset:
                 yield out
             return
 
-        yield from self._stream_shm_batches(
+        if all(a.startswith("shm://") for a in self.addresses):
+            impl = self._stream_shm_batches
+        else:
+            impl = self._stream_zmq_batches
+        yield from impl(
             batch_size,
             worker_id,
             num_workers,
@@ -345,7 +375,113 @@ class RemoteIterableDataset:
             stop_event,
             drop_last,
             timer,
+            arena_pool,
         )
+
+    def _acquire_arena(self, arena_pool, timer, stop_event):
+        """Next free arena from the pool (None without a pool).  Blocks
+        under pool exhaustion — the backpressure seam — accounted to the
+        ``arena_wait`` stage.  Raises TimeoutError if no arena frees up
+        within the stream timeout (a stuck consumer looks exactly like a
+        silent producer to the training loop)."""
+        if arena_pool is None:
+            return None, True
+        with timer.stage("arena_wait"):
+            arena = arena_pool.acquire(
+                timeout=self.timeoutms / 1e3, stop_event=stop_event
+            )
+        if arena is None:
+            if stop_event is not None and stop_event.is_set():
+                return None, False
+            raise TimeoutError(
+                f"no batch arena freed within {self.timeoutms} ms "
+                f"(pool size {arena_pool.pool_size}); the consumer has "
+                "stalled or the pool is undersized"
+            )
+        return arena, True
+
+    def _wrap_batch(self, data, arena):
+        if arena is None:
+            return data
+        from blendjax.btt.arena import ArenaBatch
+
+        return ArenaBatch(data, arena)
+
+    def _stream_zmq_batches(
+        self,
+        batch_size,
+        worker_id,
+        num_workers,
+        shard_id,
+        num_shards,
+        stop_event,
+        drop_last,
+        timer,
+        arena_pool,
+    ):
+        """Batched ZMQ fan-in: decode each multipart message's frames
+        straight into the (optionally pooled) batch buffers — the
+        deferred :class:`_BatchBuilder` mode keeps the zero-copy frame
+        views alive until the batch completes, then gathers each leaf in
+        one GIL-released call."""
+        ctx = zmq.Context.instance()
+        socket = ctx.socket(zmq.PULL)
+        socket.setsockopt(zmq.RCVHWM, self.queue_size)
+        socket.setsockopt(zmq.LINGER, 0)
+        builder = _BatchBuilder(
+            batch_size,
+            defer=True,
+            schema_cache={},  # decode plan shared across this stream's batches
+            parallel=num_workers > 1,
+        )
+        pending = False  # builder holds an unyielded (possibly empty) batch
+        arena = None
+        try:
+            for addr in self.addresses:
+                socket.connect(addr)
+            poller = zmq.Poller()
+            poller.register(socket, zmq.POLLIN)
+            count = self.max_items // (num_workers * num_shards)
+            for _ in range(count):
+                if not self._poll_message(poller, stop_event):
+                    return
+                frames = socket.recv_multipart(copy=False)
+                if not pending:
+                    arena, alive = self._acquire_arena(
+                        arena_pool, timer, stop_event
+                    )
+                    if not alive:
+                        return
+                    builder.reset(arena)
+                    pending = True
+                builder.add_message([f.buffer for f in frames])
+                if builder.full():
+                    with timer.stage("scatter"):
+                        data = builder.finish()
+                    # drop the batch's zero-copy wire frames NOW — holding
+                    # them until the next message would keep the whole
+                    # batch's frame buffers alive across the inter-batch
+                    # gap (scattered leaves are already copied; ragged
+                    # fallback views hold their own frame references)
+                    builder.reset()
+                    # hand ownership to the batch BEFORE yielding: a
+                    # generator closed at the yield would otherwise
+                    # double-release the arena from the finally below
+                    # while the yielded ArenaBatch still references it
+                    out, arena = self._wrap_batch(data, arena), None
+                    pending = False
+                    yield out
+            if pending and builder.count and not drop_last:
+                with timer.stage("scatter"):
+                    data = builder.finish()
+                builder.reset()
+                out, arena = self._wrap_batch(data, arena), None
+                pending = False
+                yield out
+        finally:
+            if arena is not None:
+                arena.release()  # acquired but never yielded (dropped tail)
+            socket.close(0)
 
     def _stream_shm_batches(
         self,
@@ -357,65 +493,137 @@ class RemoteIterableDataset:
         stop_event,
         drop_last,
         timer,
+        arena_pool=None,
     ):
         count = self.max_items // (num_workers * num_shards)
-        state = {"builder": None}
+        state = {"builder": None, "arena": None}
 
         def consume(reader, block_ms):
             frames = reader.recv_frames_view(timeout_ms=block_ms)
             if frames is None:
                 return None
             try:
-                with timer.stage("collate"):
-                    if state["builder"] is None:
-                        state["builder"] = _BatchBuilder(batch_size)
+                if state["builder"] is None:
+                    arena, alive = self._acquire_arena(
+                        arena_pool, timer, stop_event
+                    )
+                    if not alive:
+                        # stream stopping mid-acquire: drop this record and
+                        # let the rotation's own stop check end the stream
+                        return None
+                    state["arena"] = arena
+                    state["builder"] = _BatchBuilder(batch_size, arena=arena)
+                with timer.stage("scatter"):
                     state["builder"].add_message(frames)
             finally:
                 reader.release_record()
             return True
 
-        for _ in self._shm_rotation(
-            worker_id, num_workers, stop_event, consume, count
-        ):
+        try:
+            for _ in self._shm_rotation(
+                worker_id, num_workers, stop_event, consume, count
+            ):
+                builder = state["builder"]
+                if builder is not None and builder.full():
+                    with timer.stage("scatter"):
+                        data = builder.finish()
+                    # ownership moves to the batch BEFORE the yield (a
+                    # close at the yield must not re-release the arena)
+                    out = self._wrap_batch(data, state["arena"])
+                    state["builder"], state["arena"] = None, None
+                    yield out
             builder = state["builder"]
-            if builder is not None and builder.full():
-                yield builder.finish()
-                state["builder"] = None
-        builder = state["builder"]
-        if builder is not None and builder.count and not drop_last:
-            yield builder.finish()
+            if builder is not None and builder.count and not drop_last:
+                with timer.stage("scatter"):
+                    data = builder.finish()
+                out = self._wrap_batch(data, state["arena"])
+                state["builder"], state["arena"] = None, None
+                yield out
+        finally:
+            if state["arena"] is not None:
+                state["arena"].release()
 
 
 class _BatchBuilder:
     """Assembles one collated batch directly from wire frames.
 
     Array leaves (raw-buffer placeholders or ndarrays in compat pickles)
-    are memcpy'd into ``(batch_size, *shape)`` buffers preallocated on
-    first sight of each key; everything else accumulates in per-key lists
-    collated at the end.  Semantics mirror the generic
-    ``stream() + collate`` path exactly: a key whose shape/dtype drifts
-    mid-batch degrades to the ragged-list rules, keys absent from the
-    batch's first message are dropped, and a message *missing* a
-    first-message key raises KeyError (as dict collate would).
+    land in ``(batch_size, *shape)`` buffers — taken from a recycled
+    :class:`blendjax.btt.arena.Arena` when one is supplied, freshly
+    allocated otherwise; everything else accumulates in per-key lists
+    collated at the end.  Two assembly modes:
+
+    - **eager** (shm transport): each message's payloads are memcpy'd
+      into the batch buffer before the ring record is released
+      (``copy_into``, GIL released for large frames);
+    - **deferred** (``defer=True``, ZMQ transport): zero-copy frame
+      views are referenced until the batch completes, then each leaf is
+      copied ONCE into the batch buffer — via the GIL-released native
+      ``gather_into`` for large frames, ``np.stack(out=...)`` below the
+      native threshold — with no intermediate batch allocation.  After
+      the first message fixes the schema, later messages are decoded by
+      a precompiled per-stream plan (no recursive walk on the hot
+      path); any structural surprise falls back to the generic walk for
+      that message, preserving collate semantics exactly.
+
+    Semantics mirror the generic ``stream() + collate`` path exactly: a
+    key whose shape/dtype drifts mid-batch degrades to the ragged-list
+    rules, keys absent from the batch's first message are dropped, and a
+    message *missing* a first-message key raises KeyError (as dict
+    collate would).
     """
 
-    def __init__(self, batch_size):
+    #: In parallel assembly (several loader workers sharing the GIL) the
+    #: scarce resource is GIL time, not wall time: the native GIL-released
+    #: gather pays off as soon as its memcpy outweighs the per-source
+    #: pointer extraction (~3 us/source) — far below the single-thread
+    #: threshold, where the whole copy is on the critical path either way.
+    _PARALLEL_GATHER_MIN_BYTES = 16 * 1024
+
+    def __init__(self, batch_size, arena=None, defer=False, schema_cache=None,
+                 parallel=False):
         import numpy as np
 
         self._np = np
         self.batch_size = batch_size
         self.count = 0
-        self._stacked = {}  # path -> preallocated (B, ...) ndarray
-        self._lists = {}  # path -> list of leaves (generic collate at end)
+        self._arena = arena
+        self._defer = bool(defer)
+        self._parallel = bool(parallel)
+        self._stacked = {}  # eager: path -> preallocated (B, ...) ndarray
+        self._lists = {}  # eager: path -> leaves (generic collate at end)
+        self._msgs = []  # deferred: per-message frame lists (zero-copy)
         self._paths = None  # schema from the first message
+        # deferred: {'schema': {...}} shared across builders of one stream
+        # so the decode plan survives batch boundaries
+        self._schema_cache = schema_cache if schema_cache is not None else {}
 
     def full(self):
         return self.count >= self.batch_size
 
+    def reset(self, arena=None):
+        """Recycle this builder for the next batch (deferred mode): the
+        finished batch owns copies (or collate outputs), so the frame
+        references can drop; per-batch state rewinds while the stream's
+        schema cache lives on.  Returns self."""
+        self.count = 0
+        self._arena = arena
+        self._msgs.clear()
+        self._stacked = {}
+        self._lists = {}
+        self._paths = None
+        return self
+
+    def _batch_buffer(self, path, leaf_shape, dtype):
+        shape = (self.batch_size,) + tuple(leaf_shape)
+        if self._arena is not None:
+            return self._arena.get_buffer(path, shape, dtype)
+        return self._np.empty(shape, dtype)
+
     # -- leaf walking -------------------------------------------------------
 
     def _view(self, placeholder, payloads):
-        """ndarray view into the arena for a raw-buffer placeholder."""
+        """ndarray view into the frame/arena for a raw-buffer leaf."""
         np = self._np
         return np.frombuffer(
             payloads[placeholder[wire.ARRAY_PLACEHOLDER]],
@@ -424,10 +632,12 @@ class _BatchBuilder:
 
     def _resolve_copy(self, obj, payloads):
         """Deep-resolve placeholders inside a container to *owned* arrays
-        (the arena views die when the record is released)."""
+        (the shm views die when the record is released; the deferred path
+        keeps views since its frames outlive the batch)."""
         np = self._np
         if wire.is_array_placeholder(obj):
-            return np.array(self._view(obj, payloads))
+            view = self._view(obj, payloads)
+            return view if self._defer else np.array(view)
         if isinstance(obj, dict):
             return {k: self._resolve_copy(v, payloads) for k, v in obj.items()}
         if isinstance(obj, (list, tuple)):
@@ -437,10 +647,10 @@ class _BatchBuilder:
 
     def _walk(self, obj, payloads, path=()):
         """Yield (path, leaf, is_array) with raw-buffer placeholders
-        resolved to ndarray views into the arena.  list/tuple containers
-        are resolved to owned copies and treated as single leaves — the
-        final ``collate`` recurses into them exactly like the generic
-        path does."""
+        resolved to ndarray views over the payload frames.  list/tuple
+        containers are resolved and treated as single leaves — the final
+        ``collate`` recurses into them exactly like the generic path
+        does."""
         np = self._np
         if isinstance(obj, dict):
             if wire.is_array_placeholder(obj):
@@ -457,8 +667,233 @@ class _BatchBuilder:
             return
         yield path, obj, False
 
+    # -- deferred columnar decode -------------------------------------------
+
+    def _make_schema(self, head):
+        """Precompile ``head``'s structure into a columnar decode plan:
+        one entry per leaf — (path, key-chain, kind, shape, dtype-str,
+        dtype) — plus the arity of every dict node (so a key added or
+        removed anywhere in a later message invalidates the plan instead
+        of being silently mis-handled).  Kinds: 'raw' (placeholder ->
+        zero-copy payload frame), 'array' (materialized ndarray, compat
+        pickles), 'container' (list/tuple, resolved per message), 'leaf'
+        (plain value).  A batch that deviates from the plan in ANY way is
+        re-processed by the generic per-message walk, so the plan is
+        purely a fast path — never a semantics change."""
+        np = self._np
+        plan = []
+        dict_lens = []
+
+        def build(obj, path, keys):
+            if isinstance(obj, dict):
+                if wire.is_array_placeholder(obj):
+                    # the whole placeholder is static per schema (frame
+                    # index, dtype string, shape tuple): keep it as a
+                    # template so the hot path is ONE dict equality per
+                    # message instead of field-by-field checks
+                    plan.append((
+                        path, keys, "raw",
+                        (
+                            dict(obj),
+                            obj[wire.ARRAY_PLACEHOLDER],
+                            tuple(obj["shape"]),
+                            np.dtype(obj["dtype"]),
+                        ),
+                    ))
+                    return
+                dict_lens.append((keys, len(obj)))
+                for k, v in obj.items():
+                    build(v, path + (k,), keys + (k,))
+                return
+            if isinstance(obj, np.ndarray):
+                plan.append((path, keys, "array", None))
+                return
+            if isinstance(obj, (list, tuple)):
+                plan.append((path, keys, "container", None))
+                return
+            plan.append((path, keys, "leaf", None))
+
+        build(head, (), ())
+        return {"plan": plan, "dict_lens": dict_lens}
+
+    def _columnar(self, heads, msgs, schema):
+        """Collate the batch along the precompiled plan, column by
+        column — the hot path.  Returns None on ANY deviation (changed
+        arity, moved key, type change, drifted array geometry); the
+        caller then re-runs the generic per-message walk, which applies
+        the exact legacy collate semantics including per-key degrade."""
+        from blendjax.btt.collate import _NATIVE_STACK_MIN_BYTES
+        from blendjax.btt.collate import collate as list_collate
+        from blendjax.native.ring import gather_into
+
+        np = self._np
+        ndarray = np.ndarray
+        frombuffer = np.frombuffer
+        n = self.count
+        try:
+            for keys, ln in schema["dict_lens"]:
+                nodes = heads
+                for k in keys:
+                    nodes = [v[k] for v in nodes]
+                # arity check alone suffices: a non-mapping impostor that
+                # happens to have the right len still fails the leaf
+                # traversals below (KeyError/TypeError -> generic walk),
+                # matching legacy collate's duck-typed indexing
+                if not all(len(v) == ln for v in nodes):
+                    return None
+            out = {}
+            for path, keys, kind, aux in schema["plan"]:
+                vals = heads
+                for k in keys:
+                    vals = [v[k] for v in vals]
+                if kind == "raw":
+                    template, idx, shape, dtype = aux
+                    # one C-level dict equality per message; any spelling
+                    # difference (shape as list, drifted geometry, moved
+                    # frame index, type change) fails the plan and takes
+                    # the generic walk, which normalizes it
+                    if not all(
+                        type(v) is dict and v == template for v in vals
+                    ):
+                        return None
+                    fi = idx + 1  # payload frames start after the header
+                    bufs = [m[fi] for m in msgs]
+                    buf = self._batch_buffer(path, shape, dtype)
+                    dst = buf if n == self.batch_size else buf[:n]
+                    row_bytes = dst.nbytes // n if n else 0
+                    min_native = (
+                        self._PARALLEL_GATHER_MIN_BYTES
+                        if self._parallel
+                        else _NATIVE_STACK_MIN_BYTES
+                    )
+                    if row_bytes >= min_native and not dtype.hasobject:
+                        gather_into(dst, bufs)
+                    else:
+                        rows = dst.reshape(n, -1)
+                        for i, b in enumerate(bufs):
+                            rows[i] = frombuffer(b, dtype)
+                elif kind == "leaf":
+                    v0 = vals[0]
+                    t0v = type(v0)
+                    if all(type(v) is t0v for v in vals):
+                        # uniform type (the overwhelming case): one
+                        # container check on the representative
+                        if isinstance(v0, (dict, ndarray, list, tuple)):
+                            return None
+                    elif any(
+                        isinstance(v, (dict, ndarray, list, tuple))
+                        for v in vals
+                    ):
+                        return None
+                    # inlined scalar collate rules (same dispatch order)
+                    if isinstance(v0, bool):
+                        dst = np.asarray(vals, dtype=bool)
+                    elif isinstance(v0, numbers.Number):
+                        dst = np.asarray(vals)
+                    else:
+                        dst = list(vals)
+                elif kind == "array":
+                    first = vals[0]
+                    if not all(
+                        isinstance(v, ndarray)
+                        and v.shape == first.shape
+                        and v.dtype == first.dtype
+                        for v in vals
+                    ):
+                        return None
+                    buf = self._batch_buffer(path, first.shape, first.dtype)
+                    dst = buf if n == self.batch_size else buf[:n]
+                    for i, v in enumerate(vals):
+                        dst[i] = v
+                else:  # container
+                    if not all(isinstance(v, (list, tuple)) for v in vals):
+                        return None
+                    dst = list_collate([
+                        self._resolve_copy(v, msgs[i][1:])
+                        for i, v in enumerate(vals)
+                    ])
+                if len(path) == 1:
+                    out[path[0]] = dst
+                else:
+                    _set_path(out, path, dst)
+            return out
+        except (KeyError, TypeError, IndexError, ValueError):
+            # ValueError covers ambiguous ndarray comparisons from type
+            # drift; a genuinely malformed frame re-raises from the
+            # generic walk with the legacy error
+            return None
+
+    def _generic_deferred(self, heads, payload_lists):
+        """Per-message walk fallback for batches the plan cannot decode:
+        the exact legacy collate semantics (late-key drop, missing-key
+        KeyError, per-key degrade to ragged/upcast rules).  Also rebuilds
+        the stream's cached schema from this batch's first message."""
+        from blendjax.btt.collate import _NATIVE_STACK_MIN_BYTES
+        from blendjax.btt.collate import collate as list_collate
+        from blendjax.native.ring import gather_into
+
+        np = self._np
+        cols = {}
+        paths = None
+        for mi, (head, payloads) in enumerate(zip(heads, payload_lists)):
+            seen = set()
+            for path, leaf, is_array in self._walk(head, payloads):
+                if paths is not None and path not in paths:
+                    # generic collate keys the batch off its first item and
+                    # silently drops keys that only appear later — match it
+                    continue
+                seen.add(path)
+                cols.setdefault(path, []).append((leaf, is_array))
+            if paths is None:
+                paths = seen
+                self._schema_cache["schema"] = self._make_schema(head)
+            elif seen != paths:
+                # a slot without a value for a first-message key would
+                # silently misalign every later slot — fail loudly like
+                # dict collate
+                missing = sorted(map(str, paths - seen))
+                raise KeyError(
+                    f"stream message {mi} of the current batch is missing "
+                    f"key(s) {missing} present in the batch's first message"
+                )
+        n = self.count
+        out = {}
+        for path, col in cols.items():
+            if col and all(is_arr for _, is_arr in col):
+                first = col[0][0]
+                if all(
+                    v.shape == first.shape and v.dtype == first.dtype
+                    for v, _ in col
+                ):
+                    buf = self._batch_buffer(path, first.shape, first.dtype)
+                    dst = buf if n == self.batch_size else buf[:n]
+                    vals = [v for v, _ in col]
+                    min_native = (
+                        self._PARALLEL_GATHER_MIN_BYTES
+                        if self._parallel
+                        else _NATIVE_STACK_MIN_BYTES
+                    )
+                    if (
+                        first.nbytes >= min_native
+                        and not first.dtype.hasobject
+                    ):
+                        gather_into(dst, vals)
+                    else:
+                        np.stack(vals, out=dst)
+                    _set_path(out, path, dst)
+                    continue
+            vals = [v for v, _ in col]
+            _set_path(out, path, list_collate(vals) if vals else vals)
+        return out
+
     def add_message(self, frames):
-        """Consume one message's frames (views valid only for this call)."""
+        """Consume one message's frames.  Eager mode copies the payloads
+        out before returning (shm record lifetime); deferred mode just
+        references the zero-copy frames until :meth:`finish`."""
+        if self._defer:
+            self._msgs.append(frames)
+            self.count += 1
+            return
         from blendjax.native import copy_into
 
         np = self._np
@@ -478,8 +913,8 @@ class _BatchBuilder:
                 )
                 continue
             if is_array and i == 0:
-                self._stacked[path] = np.empty(
-                    (self.batch_size,) + leaf.shape, leaf.dtype
+                self._stacked[path] = self._batch_buffer(
+                    path, leaf.shape, leaf.dtype
                 )
             buf = self._stacked.get(path)
             if buf is not None and (
@@ -489,9 +924,11 @@ class _BatchBuilder:
                 continue
             # shape/dtype drift (or a non-array leaf): degrade this key to
             # list mode, preserving earlier slots; the final collate then
-            # applies the same ragged/upcast rules as the generic path
+            # applies the same ragged/upcast rules as the generic path.
+            # Slots are COPIED out — a bare view would alias the (possibly
+            # arena-backed, recycled) batch buffer and mutate after reuse
             prior = (
-                [buf[j] for j in range(i)]
+                [np.array(buf[j]) for j in range(i)]
                 if buf is not None
                 else self._lists.get(path, [])
             )
@@ -513,6 +950,8 @@ class _BatchBuilder:
 
     def finish(self):
         """Return the collated batch pytree (nested dict)."""
+        if self._defer:
+            return self._finish_deferred()
         from blendjax.btt.collate import collate as list_collate
 
         n = self.count
@@ -522,6 +961,28 @@ class _BatchBuilder:
         for path, vals in self._lists.items():
             _set_path(out, path, list_collate(vals) if vals else vals)
         return out
+
+    def _finish_deferred(self):
+        """Deferred columnar collation: parse the batch's headers in one
+        pass, then collate column-by-column along the stream's cached
+        plan — uniform array columns copy ONCE into the batch buffer (a
+        GIL-released native ``gather_into`` for large frames, per-row
+        assignment below the native threshold, where pointer extraction
+        would cost more than the memcpy saves).  Any deviation from the
+        plan falls back to the generic per-message walk (ragged,
+        mixed-dtype, schema drift, compat containers) — the legacy
+        collate rules, applied per key."""
+        if not self._msgs:
+            return {}
+        loads = pickle.loads
+        msgs = self._msgs
+        heads = [loads(f[0]) for f in msgs]
+        schema = self._schema_cache.get("schema")
+        if schema is not None:
+            out = self._columnar(heads, msgs, schema)
+            if out is not None:
+                return out
+        return self._generic_deferred(heads, [f[1:] for f in msgs])
 
 
 def _set_path(tree, path, value):

@@ -51,6 +51,12 @@ class BatchLoader:
         transfer holds the gate closed (see ``prefetch.TransferGate``) —
         keeps feed threads off the core the transfer pump needs on
         core-starved hosts.
+    arena_pool: blendjax.btt.arena.ArenaPool | None
+        When set (and the dataset takes the batched path), batches
+        assemble into recycled arena buffers and come out as
+        ``ArenaBatch`` objects; the consumer must recycle each one after
+        its bytes are consumed (the device prefetcher does this once the
+        transfer completes).  Pool exhaustion backpressures the workers.
     """
 
     def __init__(
@@ -64,6 +70,7 @@ class BatchLoader:
         prefetch_batches=2,
         timer=None,
         gate=None,
+        arena_pool=None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -74,6 +81,7 @@ class BatchLoader:
         self.shard = shard
         self.drop_last = drop_last
         self.gate = gate
+        self.arena_pool = arena_pool
         self.timer = timer or StageTimer()
         self._queue = queue.Queue(maxsize=max(2, prefetch_batches))
         self._stop = threading.Event()
@@ -137,6 +145,7 @@ class BatchLoader:
                     stop_event=self._stop,
                     drop_last=self.drop_last,
                     timer=self.timer,
+                    arena_pool=self.arena_pool,
                 )
                 while True:
                     if self.gate is not None:
@@ -150,6 +159,11 @@ class BatchLoader:
                     except StopIteration:
                         break
                     if not self._put(out):
+                        # stop raced the enqueue: the batch was already
+                        # detached from the stream generator, so recycle
+                        # its arena here or nobody will
+                        if hasattr(out, "recycle"):
+                            out.recycle()
                         return
                     if self._stop.is_set():
                         return
@@ -200,10 +214,14 @@ class BatchLoader:
             # shutdown (abandoned iterator): the queue module is already torn
             # down and the daemon workers are dead — nothing to drain or join.
             return
-        # drain so blocked put() calls can observe the stop flag
+        # drain so blocked put() calls can observe the stop flag; recycle
+        # any arena batches stranded in the queue so a shared pool is not
+        # starved by an early close
         try:
             while True:
-                self._queue.get_nowait()
+                item = self._queue.get_nowait()
+                if hasattr(item, "recycle"):
+                    item.recycle()
         except queue.Empty:
             pass
         for t in self._threads:

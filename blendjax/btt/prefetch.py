@@ -137,6 +137,50 @@ def _resolve_gate(transfer_gate, num_workers):
     )
 
 
+def _resolve_arena(arena, dataset, collate_fn, num_workers, prefetch):
+    """Resolve JaxStream's ``arena`` option to an ArenaPool (or None).
+
+    'auto' (the default) enables arena-pooled batch assembly whenever
+    the dataset supports the batched stream path and the default collate
+    is in use — i.e. fixed-shape raw-buffer streams get recycled batch
+    buffers out of the box, with the legacy collate fallback applying
+    per key for ragged/compat traffic.  Pool depth covers every place a
+    batch can be in flight at once (loader queue + device queue + one in
+    transfer + one building per worker).
+    """
+    from blendjax.btt.arena import ArenaPool
+
+    # identity checks: `0 in (False, None)` is True, and arena=0 must hit
+    # ArenaPool's pool_size validation below, not silently disable
+    if arena is False or arena is None:
+        return None
+    if isinstance(arena, ArenaPool):
+        return arena
+    supported = (
+        collate_fn is None
+        and hasattr(dataset, "supports_batched_stream")
+        and dataset.supports_batched_stream()
+    )
+    if arena == "auto":
+        if not supported:
+            return None
+        return ArenaPool(pool_size=num_workers + prefetch + 3)
+    if arena is True:
+        if not supported:
+            raise ValueError(
+                "arena=True requires a dataset whose batched stream path "
+                "is available (no recording/per-item transform) and the "
+                "default collate"
+            )
+        return ArenaPool(pool_size=num_workers + prefetch + 3)
+    if isinstance(arena, int):
+        return ArenaPool(pool_size=arena)
+    raise ValueError(
+        f"arena must be 'auto', a bool, None, an int pool size, or an "
+        f"ArenaPool; got {arena!r}"
+    )
+
+
 def put_batch(batch, sharding=None):
     """Place one host batch (numpy pytree) onto device(s).
 
@@ -201,21 +245,39 @@ def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None,
     stop = threading.Event()
 
     def _producer():
+        from blendjax.btt.arena import ArenaBatch
+
+        batch = None
         try:
             for batch in iterator:
                 if stop.is_set():
+                    if isinstance(batch, ArenaBatch):
+                        batch.recycle()
                     return
+                host_batch = (
+                    batch.data if isinstance(batch, ArenaBatch) else batch
+                )
                 if transform is not None:
-                    batch = transform(batch)
+                    host_batch = transform(host_batch)
                 with timer.stage("device_put"):
                     if gate is not None:
                         with gate.transfer():
-                            dev_batch = put_batch(batch, sharding)
+                            dev_batch = put_batch(host_batch, sharding)
                             # the gate must stay closed until the bytes have
                             # actually landed, not just been dispatched
                             jax.block_until_ready(dev_batch)
                     else:
-                        dev_batch = put_batch(batch, sharding)
+                        dev_batch = put_batch(host_batch, sharding)
+                if isinstance(batch, ArenaBatch):
+                    # the arena returns to the freelist only once the
+                    # transfer has COMPLETED (dispatch alone still reads
+                    # host memory); a slow trainer therefore backpressures
+                    # into the pool instead of allocating unboundedly.
+                    # The gated path already blocked above.
+                    if gate is None:
+                        jax.block_until_ready(dev_batch)
+                    with timer.stage("recycle"):
+                        batch.recycle()
                 while True:
                     try:
                         q.put(dev_batch, timeout=0.5)
@@ -225,6 +287,10 @@ def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None,
                             return
             q.put(_SENTINEL)
         except BaseException as exc:  # noqa: BLE001 - forwarded to consumer
+            # a transform/put failure must not strand the in-hand arena
+            # (recycle is idempotent, so an already-recycled batch is safe)
+            if isinstance(batch, ArenaBatch):
+                batch.recycle()
             q.put(exc)
 
     thread = threading.Thread(target=_producer, daemon=True, name="bjx-prefetch")
@@ -259,8 +325,18 @@ class JaxStream:
         for batch in stream:          # jax.Arrays already in HBM
             state, loss = train_step(state, batch)
 
-    ``stream.timer.summary()`` exposes recv/collate/device_put stage times;
+    ``stream.timer.summary()`` exposes the per-stage feed times (recv /
+    scatter / arena_wait / device_put / recycle on the arena path,
+    recv / collate / device_put on the legacy path);
     ``stream.duty_cycle(...)`` measures the feed's headroom.
+
+    ``arena='auto'`` (default) assembles batches into recycled
+    arena-pooled buffers (:mod:`blendjax.btt.arena`) whenever the
+    dataset supports the batched stream path: one host copy from wire
+    frame to batch slot, arenas recycled only after each device
+    transfer completes (pool exhaustion = backpressure).  Pass False to
+    force the legacy per-batch allocation, an int to size the pool, or
+    a shared ``ArenaPool``.
     """
 
     def __init__(
@@ -276,10 +352,14 @@ class JaxStream:
         collate_fn=None,
         timer=None,
         transfer_gate="auto",
+        arena="auto",
     ):
         from blendjax.btt.loader import BatchLoader
 
         self.gate = _resolve_gate(transfer_gate, num_workers)
+        self.arena_pool = _resolve_arena(
+            arena, dataset, collate_fn, num_workers, prefetch
+        )
         self.loader = BatchLoader(
             dataset,
             batch_size,
@@ -289,6 +369,7 @@ class JaxStream:
             collate_fn=collate_fn,
             timer=timer,
             gate=self.gate,
+            arena_pool=self.arena_pool,
         )
         self.sharding = sharding
         self.transform = transform

@@ -6,6 +6,7 @@ from blendjax.native.ring import (  # noqa: F401
     ShmRingWriter,
     copy_into,
     fast_stack,
+    gather_into,
     is_shm_address,
     native_available,
     shm_name_from_address,

@@ -411,15 +411,79 @@ def copy_into(dst, src):
     lib.bjr_gather(dst.ctypes.data_as(ctypes.c_void_p), ptrs, lens, 1)
 
 
+def _src_ptr_len(obj):
+    """(pointer, nbytes, keepalive) for a read-only source buffer.
+
+    ndarrays expose their data pointer directly (non-contiguous ones are
+    compacted once); anything buffer-like (memoryview into a ZMQ frame or
+    shm record, bytes) goes through a zero-copy ``np.frombuffer`` view,
+    which also keeps the underlying buffer alive for the call.
+    """
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        if not obj.flags["C_CONTIGUOUS"]:
+            obj = np.ascontiguousarray(obj)
+        return obj.ctypes.data, obj.nbytes, obj
+    arr = np.frombuffer(obj, np.uint8)
+    return arr.ctypes.data, arr.nbytes, arr
+
+
+def gather_into(dst, srcs):
+    """Copy ``srcs`` (buffers/ndarrays) back-to-back into ``dst``, GIL
+    released — ONE native call per batch leaf instead of one Python-level
+    copy per sample, so large-frame scatters overlap with the recv thread
+    and with other loader workers.
+
+    ``dst`` must be a C-contiguous ndarray whose total bytes equal the
+    summed source bytes (the batch-assembly contract: ``dst`` is an
+    arena leaf ``(n, *shape)`` and ``srcs`` are the n per-sample
+    payloads).  Falls back to numpy slice copies when the native library
+    is unavailable.
+    """
+    import numpy as np
+
+    if not dst.flags["C_CONTIGUOUS"] or dst.dtype.hasobject:
+        raise ValueError("gather_into requires a C-contiguous non-object dst")
+    n = len(srcs)
+    ptrs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_uint64 * n)()
+    keep = []
+    total = 0
+    for i, s in enumerate(srcs):
+        ptr, ln, alive = _src_ptr_len(s)
+        ptrs[i] = ptr
+        lens[i] = ln
+        total += ln
+        keep.append(alive)
+    if total != dst.nbytes:
+        raise ValueError(
+            f"source bytes {total} != destination bytes {dst.nbytes}"
+        )
+    lib = _load()
+    if lib is None:
+        flat = dst.reshape(-1).view(np.uint8)
+        off = 0
+        for alive in keep:
+            ln = alive.nbytes
+            flat[off : off + ln] = alive.reshape(-1).view(np.uint8)
+            off += ln
+    elif n:
+        lib.bjr_gather(dst.ctypes.data_as(ctypes.c_void_p), ptrs, lens, n)
+    del keep
+    return dst
+
+
 def fast_stack(items, out=None):
     """Stack equal-shape ndarrays on a new leading axis, GIL released.
 
     ``np.stack`` holds the GIL for the whole copy, so concurrent
     :class:`blendjax.btt.loader.BatchLoader` workers serialize their
     collation through one core.  This variant memcpys each source into the
-    preallocated batch buffer via the native ``bjr_gather``; ctypes drops
-    the GIL for the call, so k loader threads collate on k cores.  Falls
-    back to ``np.stack`` when the native library is unavailable.
+    preallocated batch buffer via the native ``bjr_gather``
+    (:func:`gather_into`); ctypes drops the GIL for the call, so k loader
+    threads collate on k cores.  Falls back to ``np.stack`` when the
+    native library is unavailable.
     """
     import numpy as np
 
@@ -428,8 +492,7 @@ def fast_stack(items, out=None):
     for a in items[1:]:
         if a.shape != first.shape or a.dtype != first.dtype:
             raise ValueError("fast_stack requires equal shapes and dtypes")
-    lib = _load()
-    if lib is None or first.dtype.hasobject:
+    if _load() is None or first.dtype.hasobject:
         # object dtypes hold PyObject pointers: a raw memcpy would skip the
         # increfs and corrupt refcounts
         return np.stack(items, out=out)
@@ -444,16 +507,4 @@ def fast_stack(items, out=None):
             f"out must be C-contiguous with shape {(n,) + first.shape} and "
             f"dtype {first.dtype}, got {out.shape} {out.dtype}"
         )
-    ptrs = (ctypes.c_void_p * n)()
-    lens = (ctypes.c_uint64 * n)()
-    keep = []
-    nbytes = first.nbytes
-    for i, a in enumerate(items):
-        if not a.flags["C_CONTIGUOUS"]:
-            a = np.ascontiguousarray(a)
-        keep.append(a)
-        ptrs[i] = a.ctypes.data
-        lens[i] = nbytes
-    lib.bjr_gather(out.ctypes.data_as(ctypes.c_void_p), ptrs, lens, n)
-    del keep
-    return out
+    return gather_into(out, items)

@@ -399,6 +399,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_kv, interpret,
     tk, h_kv = k.shape[1], k.shape[2]
     _check_blocks(t, block_q, "block_q")
     _check_blocks(tk, block_kv, "block_kv")
+    _check_window_overshoot(window, q_offset, t, tk)
     if h % h_kv:
         raise ValueError(
             f"q heads {h} must be a multiple of kv heads {h_kv} (GQA)"
@@ -456,6 +457,22 @@ def _check_window(causal, window):
         raise ValueError(f"window must be >= 1, got {window}")
 
 
+def _check_window_overshoot(window, q_offset, tq, tk):
+    """Enforce the windowed-overshoot invariant the kernels rely on: a
+    clamped last-KV-block overshoot at ``q_offset == 0`` is only killed
+    by the causal bound when ``Tk == Tq`` (true for every current call
+    site — full sequences and same-shard ring pairs).  ``Tk != Tq`` with
+    a zero offset would read the clamped block with a LIVE mask and
+    silently attend out of window, so fail loudly instead (ADVICE r5)."""
+    if window is not None and not q_offset and tk != tq:
+        raise ValueError(
+            f"windowed attention with q_offset=0 requires Tk == Tq (got "
+            f"Tq={tq}, Tk={tk}): the overshoot clamp relies on the causal "
+            "bound to kill the last KV block, which only holds for "
+            "same-length pairs; pass the pair's static q_offset"
+        )
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
@@ -508,6 +525,7 @@ def _dq_pass(qf, kf, vf, dof, lse, delta, causal, scale, block_q,
     rounded partials)."""
     bh, tq, d = qf.shape
     tk = kf.shape[1]
+    _check_window_overshoot(window, q_offset, tq, tk)
     num_q, num_kv = tq // block_q, tk // block_kv
     khm = _kv_head_map(*heads) if heads else None
     kv_steps, kv_im = _kv_axis(

@@ -126,6 +126,22 @@ def maybe_quantized_einsum(eq, x, p, dtype):
     product of scales: one rule for every equation)."""
     if "w_q" not in p:
         return jnp.einsum(eq, x, p["w"].astype(dtype))
+    if p["w_scale"].ndim != p["w_q"].ndim:
+        # two scale-shape conventions coexist in this module:
+        # quantize_seqformer keeps KEEPDIMS scales (required here — the
+        # dequant einsum needs the scale to broadcast like the weight),
+        # while quantize_dense/quantize_conv flatten to reshape(-1) for
+        # the detector's apply kernels.  Mixing them used to surface as
+        # an opaque einsum ndim mismatch (ADVICE r5) — name it instead.
+        raise ValueError(
+            f"maybe_quantized_einsum needs keepdims weight scales "
+            f"(w_scale.ndim == w_q.ndim == {p['w_q'].ndim}, got "
+            f"w_scale.ndim {p['w_scale'].ndim}): this dict looks like a "
+            "detector-style quantization (quantize_dense/quantize_conv "
+            "flatten scales with reshape(-1) for the conv/dense apply "
+            "kernels); quantize with quantize_seqformer-style keepdims "
+            "scales (quantize_tensor output, unreshaped) for einsum use"
+        )
     xq, xs = quantize_tensor(x, reduce_axes=_x_contracted_axes(eq, x.ndim))
     acc = jnp.einsum(eq, xq, p["w_q"], preferred_element_type=jnp.int32)
     scale = jnp.einsum(eq, xs, p["w_scale"])

@@ -29,6 +29,17 @@ import time
 from collections import defaultdict
 from contextlib import contextmanager
 
+#: Canonical feed-pipeline stage names (see docs/feed_pipeline.md).
+#: ``recv``/``collate``/``device_put`` cover the legacy path; the
+#: arena-pooled assembly adds ``arena_wait`` (blocked acquiring a free
+#: batch arena — i.e. trainer backpressure), ``scatter`` (wire frame ->
+#: batch-buffer copy) and ``recycle`` (arena returned after the device
+#: transfer completes).  StageTimer itself accepts any name; this tuple
+#: is the shared vocabulary bench.py and the suite report under.
+FEED_STAGES = (
+    "recv", "collate", "arena_wait", "scatter", "recycle", "device_put",
+)
+
 
 class StageTimer:
     """Accumulates wall-clock time per named stage (thread-safe: stages are
@@ -63,6 +74,16 @@ class StageTimer:
                 self._events.append(
                     (name, start, seconds, threading.get_ident())
                 )
+
+    def add_bulk(self, name, total_seconds, count):
+        """Accumulate ``count`` pre-aggregated intervals in one locked
+        update — for hot loops (e.g. the arena feed path at ~100 us per
+        batch) where a per-interval :meth:`add` would itself be a
+        measurable stage.  Not recorded as trace events (aggregates have
+        no start times)."""
+        with self._lock:
+            self._total[name] += total_seconds
+            self._count[name] += count
 
     @property
     def wall_s(self):

@@ -125,13 +125,19 @@ def train(
             actor, critic, opt_state, batch
         )
         finished = float(dones.sum())
+        # weight the reward sum by the SAME mask the loss uses: the
+        # fabricated reset-step transitions (whose actions never ran)
+        # must not inflate the logged return any more than they train
+        # the policy (ADVICE r5)
+        mask_t = batch["mask"].reshape(rewards.shape)
+        masked_reward = float((rewards * mask_t).sum())
         if finished:
-            mean_ep = float(rewards.sum()) / finished
+            mean_ep = masked_reward / finished
         else:
             # no episode closed this horizon: report reward per LANE so
             # the log stays comparable instead of printing the raw total
             # as "reward/episode"
-            mean_ep = float(rewards.sum()) / rewards.shape[1]
+            mean_ep = masked_reward / rewards.shape[1]
         returns_log.append(mean_ep)
         if log_every and (it + 1) % log_every == 0:
             print(f"iter {it + 1}: loss {float(loss):.4f} "

@@ -89,8 +89,15 @@ def test_jax_stream_end_to_end():
         assert b["image"].dtype == np.float32
         assert float(b["image"].max()) <= 1.0
     stats = stream.timer.summary()
-    assert {"recv", "collate", "device_put"} <= set(stats)
+    # default feed: arena-pooled zero-copy assembly (scatter into recycled
+    # batch buffers + recycle-after-transfer) instead of the legacy collate
+    assert {"recv", "scatter", "arena_wait", "device_put", "recycle"} <= set(
+        stats
+    )
     assert stats["device_put"]["count"] == 4
+    # every transferred batch returned its arena to the pool
+    assert stats["recycle"]["count"] == 4
+    assert stream.arena_pool is not None and stream.arena_pool.in_use == 0
 
 
 def test_put_batch_indivisible_raises():
