@@ -9,7 +9,7 @@ PYTHON ?= python
 # tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
 SHELL := /bin/bash
 
-.PHONY: test tier1 blender-tests tpu-tests bench dryrun
+.PHONY: test tier1 chaos blender-tests tpu-tests bench dryrun
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -30,6 +30,14 @@ tier1:
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
 		| tr -cd . | wc -c); \
 	exit $$rc
+
+# The chaos pack (tests/test_chaos.py + FaultPolicy units): deterministic
+# fault injection — proxy stall/drop/garble, producer SIGKILL, supervised
+# restart-and-resync.  Includes the `slow` soak cycles that tier-1 skips.
+# See docs/fault_tolerance.md.
+chaos:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/ -m chaos -q -rs
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.

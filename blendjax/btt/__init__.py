@@ -33,6 +33,10 @@ _LAZY = {
     "BlenderVectorEnv": ("blendjax.btt.vector_env", "BlenderVectorEnv"),
     "launch_vector_env": ("blendjax.btt.vector_env", "launch_vector_env"),
     "FleetWatchdog": ("blendjax.btt.watchdog", "FleetWatchdog"),
+    "FleetSupervisor": ("blendjax.btt.supervise", "FleetSupervisor"),
+    "FaultPolicy": ("blendjax.btt.faults", "FaultPolicy"),
+    "CircuitOpenError": ("blendjax.btt.faults", "CircuitOpenError"),
+    "ChaosProxy": ("blendjax.btt.chaos", "ChaosProxy"),
     "get_primary_ip": ("blendjax.btt.utils", "get_primary_ip"),
 }
 
@@ -52,6 +56,9 @@ _LAZY_MODULES = (
     "vector_env",
     "env_rendering",
     "watchdog",
+    "supervise",
+    "faults",
+    "chaos",
     "torch_compat",
     "utils",
     "constants",

@@ -1,0 +1,316 @@
+"""Deterministic fault injection for fleet testing.
+
+The failure paths in :mod:`blendjax.btt.faults`, :mod:`.envpool` and
+:mod:`.supervise` are only trustworthy if they can be exercised *on
+demand* — not by hoping a sleep lines up with a crash.  This module
+provides:
+
+- :class:`ChaosProxy` — a wire-level TCP relay to park between a consumer
+  and one producer endpoint.  It can **stall** (stop forwarding: the
+  consumer sees silence, exactly like a hung renderer), **cut** (close
+  live connections mid-message: a crashed peer at the TCP layer), and
+  **drop / duplicate / garble / delay** individual chunks, either
+  programmatically or on a deterministic per-chunk schedule.  Byte
+  positions for garbling come from a seeded ``random.Random``.
+- :func:`kill_instance` — SIGKILL a launched producer's whole process
+  group (no cleanup runs: shm rings linger, sockets die mid-message —
+  the honest crash).
+
+Determinism notes: chunk indices count ``recv()`` chunks per direction —
+with request/reply traffic (REQ/REP envs) each message is one chunk after
+the ZMQ handshake, so schedules are reproducible; for firehose PUSH/PULL
+streams prefer the programmatic controls (``stall``/``cut``), which do
+not depend on TCP segmentation.  None of this needs elevated privileges
+or external tools, so the chaos tests run in any CI container.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import socket
+import threading
+import time
+
+logger = logging.getLogger("blendjax")
+
+#: Actions a schedule entry may name.
+ACTIONS = ("drop", "dup", "garble", "close", "delay")
+
+
+def _parse_endpoint(endpoint):
+    """'tcp://host:port' | (host, port) | port -> (host, port)."""
+    if isinstance(endpoint, int):
+        return "127.0.0.1", endpoint
+    if isinstance(endpoint, (tuple, list)):
+        return endpoint[0], int(endpoint[1])
+    addr = endpoint
+    if addr.startswith("tcp://"):
+        addr = addr[len("tcp://"):]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class ChaosProxy:
+    """TCP relay with scheduled and programmatic fault injection.
+
+    Point the consumer at :attr:`address` instead of the producer's
+    endpoint; the proxy accepts any number of consumer connections and
+    pipes each to its own upstream connection.
+
+    Params
+    ------
+    upstream: str | int | (host, port)
+        The real producer endpoint (``tcp://host:port`` form accepted,
+        so ``launch_info.addresses['GYM'][i]`` drops straight in).
+    listen_host: str
+        Interface to listen on (an ephemeral port is chosen).
+    seed: int
+        Seeds the byte-position stream used by ``garble``.
+    delay_s: float
+        Constant forwarding delay applied to every chunk (both
+        directions) — network latency emulation.
+    """
+
+    def __init__(self, upstream, listen_host="127.0.0.1", seed=0, delay_s=0.0):
+        self._up_host, self._up_port = _parse_endpoint(upstream)
+        self._rng = random.Random(seed)
+        self.delay_s = delay_s
+        self._stop = threading.Event()
+        self._open = threading.Event()
+        self._open.set()
+        self._lock = threading.Lock()
+        self._conns = []  # live (client, upstream) socket pairs
+        self._sched = {"up": {}, "down": {}}  # chunk index -> action
+        self.chunks = {"up": 0, "down": 0}
+        self.forwarded_bytes = {"up": 0, "down": 0}
+        self.dropped = 0
+        self.garbled = 0
+        self.duplicated = 0
+        self.cuts = 0
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen(16)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self.address = f"tcp://{self.host}:{self.port}"
+        self._threads = [
+            threading.Thread(target=self._accept_loop, daemon=True,
+                             name="bjx-chaos-accept")
+        ]
+        self._threads[0].start()
+
+    # -- scheduling & control ------------------------------------------------
+
+    def at(self, chunk, action, direction="down"):
+        """Schedule ``action`` for chunk index ``chunk`` of ``direction``
+        ('up' = consumer->producer, 'down' = producer->consumer).
+        Deterministic: the same traffic pattern hits the same chunk."""
+        if action not in ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}; one of {ACTIONS}")
+        with self._lock:
+            self._sched[direction][int(chunk)] = action
+
+    def _next(self, action, direction):
+        with self._lock:
+            self._sched[direction][self.chunks[direction]] = action
+
+    def drop_next(self, direction="down"):
+        """Discard the next chunk (lost datagram / dropped frame)."""
+        self._next("drop", direction)
+
+    def dup_next(self, direction="down"):
+        """Forward the next chunk twice (duplicated delivery)."""
+        self._next("dup", direction)
+
+    def garble_next(self, direction="down"):
+        """Flip deterministic bytes in the next chunk (corruption; a ZMQ
+        peer treats this as a protocol violation and drops the
+        connection, which is the point)."""
+        self._next("garble", direction)
+
+    def close_next(self, direction="down"):
+        """Close both sides when the next chunk arrives — the
+        kill-mid-message case: the peer crashed while its reply was on
+        the wire."""
+        self._next("close", direction)
+
+    def stall(self):
+        """Stop forwarding in both directions (hung producer): the
+        consumer sees silence until :meth:`resume`, not a disconnect."""
+        self._open.clear()
+
+    def resume(self):
+        self._open.set()
+
+    def cut(self):
+        """Close every live connection now (crashed peer).  The listener
+        stays up, so ZMQ's automatic reconnect comes back through the
+        proxy."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for pair in conns:
+            self._close_pair(pair)
+        if conns:
+            self.cuts += 1
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                up = socket.create_connection(
+                    (self._up_host, self._up_port), timeout=10
+                )
+            except OSError:
+                client.close()
+                time.sleep(0.05)  # upstream down: shed and let ZMQ redial
+                continue
+            for s in (client, up):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            pair = (client, up)
+            with self._lock:
+                self._conns.append(pair)
+            for src, dst, direction in (
+                (client, up, "up"), (up, client, "down"),
+            ):
+                t = threading.Thread(
+                    target=self._pump, args=(src, dst, direction, pair),
+                    daemon=True, name=f"bjx-chaos-{direction}",
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _close_pair(self, pair):
+        for s in pair:
+            # shutdown first: close() alone would not terminate the
+            # connection while the sibling pump thread is blocked in
+            # recv() on the fd (the kernel keeps the open file
+            # description alive under the in-flight syscall — no FIN
+            # would ever reach the peer)
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _pump(self, src, dst, direction, pair):
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = src.recv(65536)
+                except OSError:
+                    return
+                if not data:
+                    return
+                # stall gate: hold the chunk (and everything behind it)
+                while not self._open.wait(0.05):
+                    if self._stop.is_set():
+                        return
+                with self._lock:
+                    idx = self.chunks[direction]
+                    self.chunks[direction] = idx + 1
+                    action = self._sched[direction].pop(idx, None)
+                if self.delay_s > 0:
+                    time.sleep(self.delay_s)
+                if action == "drop":
+                    self.dropped += 1
+                    continue
+                if action == "close":
+                    self.cuts += 1
+                    self._close_pair(pair)
+                    return
+                if action == "garble":
+                    data = bytearray(data)
+                    for _ in range(max(1, len(data) // 64)):
+                        data[self._rng.randrange(len(data))] ^= 0xFF
+                    data = bytes(data)
+                    self.garbled += 1
+                try:
+                    dst.sendall(data)
+                    if action == "dup":
+                        dst.sendall(data)
+                        self.duplicated += 1
+                except OSError:
+                    return
+                with self._lock:
+                    self.forwarded_bytes[direction] += len(data)
+        finally:
+            self._close_pair(pair)
+
+    def close(self):
+        self._stop.set()
+        self._open.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.cut()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def wait_env_ready(addresses, timeout_s=30.0):
+    """Block until every GYM endpoint answers a ``reset`` handshake —
+    the deterministic startup barrier for fault tests: counters measured
+    after it reflect injected faults only, never producer boot time.
+    Each attempt uses a throwaway REQ socket (no strict-alternation
+    lockup on timeout).  Raises TimeoutError naming the silent endpoint.
+    """
+    import zmq
+
+    from blendjax import wire
+
+    ctx = zmq.Context.instance()
+    deadline = time.monotonic() + timeout_s
+    for addr in addresses:
+        while True:
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            if remaining_ms <= 0:
+                raise TimeoutError(
+                    f"environment at {addr} not ready within {timeout_s}s"
+                )
+            s = ctx.socket(zmq.REQ)
+            s.setsockopt(zmq.LINGER, 0)
+            s.connect(addr)
+            try:
+                wire.send_message(s, {"cmd": "reset", "time": None})
+                if s.poll(min(1000, remaining_ms), zmq.POLLIN):
+                    wire.recv_message(s)
+                    break
+            except zmq.Again:
+                pass
+            finally:
+                s.close(0)
+
+
+def kill_instance(launcher, idx, sig=signal.SIGKILL):
+    """Kill producer ``idx``'s whole process group with no cleanup — the
+    honest crash (shm rings linger, REQ/REP peers die mid-conversation).
+    Returns the killed process object; pair with
+    :class:`~blendjax.btt.watchdog.FleetWatchdog` / ``FleetSupervisor``
+    restarts to exercise the respawn-and-resync path."""
+    proc = launcher.launch_info.processes[idx]
+    try:
+        if os.name == "posix":
+            os.killpg(os.getpgid(proc.pid), sig)
+        else:  # pragma: no cover - windows CI
+            proc.kill()
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+    return proc

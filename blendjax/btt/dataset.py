@@ -30,6 +30,7 @@ import zmq
 from blendjax import wire
 from blendjax.btt.constants import DEFAULT_TIMEOUTMS
 from blendjax.btt.file import FileReader, FileRecorder
+from blendjax.utils.timing import fleet_counters
 
 
 def _identity(x):
@@ -70,6 +71,12 @@ class RemoteIterableDataset:
     record_path_prefix: str | None
         When set, worker ``w`` records raw messages to
         ``{prefix}_{w:02d}.btr`` while streaming.
+    counters: EventCounters | None
+        Sink for ``stream_timeouts`` / ``stream_ring_vanished`` events;
+        defaults to the process-wide
+        ``blendjax.utils.timing.fleet_counters``.  Pass the same instance
+        as the fleet's ``FleetSupervisor`` for isolated per-fleet
+        accounting in ``health()``.
     """
 
     def __init__(
@@ -80,6 +87,7 @@ class RemoteIterableDataset:
         max_items=100000,
         item_transform=None,
         record_path_prefix=None,
+        counters=None,
     ):
         self.addresses = list(addresses)
         self.queue_size = queue_size
@@ -87,6 +95,7 @@ class RemoteIterableDataset:
         self.max_items = max_items
         self.record_path_prefix = record_path_prefix
         self.item_transform = item_transform or _identity
+        self.counters = counters if counters is not None else fleet_counters
 
     def enable_recording(self, fname):
         """Record while streaming; set before iteration starts."""
@@ -177,6 +186,7 @@ class RemoteIterableDataset:
                 return True
             waited += slice_ms
             if waited >= self.timeoutms:
+                self.counters.incr("stream_timeouts")
                 raise TimeoutError(
                     f"No message within {self.timeoutms} ms from "
                     f"{self.addresses}"
@@ -218,10 +228,11 @@ class RemoteIterableDataset:
                         block_ms = 100 if len(readers) == 1 else 0
                         continue
                     except ConnectionResetError:
-                        # ring vanished and the producer isn't back within
-                        # this slice; the reader stays retryable, so keep
-                        # rotating until the dataset timeout expires (the
-                        # watchdog respawn may land any moment)
+                        # ring vanished (rc -4) and the producer isn't back
+                        # within this slice; the reader stays retryable, so
+                        # keep rotating until the dataset timeout expires
+                        # (the watchdog respawn may land any moment)
+                        self.counters.incr("stream_ring_vanished")
                         waited_ms += max(block_ms, 0)
                         continue
                     if res is None:
@@ -238,6 +249,7 @@ class RemoteIterableDataset:
                         time.sleep(0.001)
                         waited_ms += 1
                     if waited_ms >= self.timeoutms:
+                        self.counters.incr("stream_timeouts")
                         raise TimeoutError(
                             f"No message within {self.timeoutms} ms from {mine}"
                         )

@@ -22,9 +22,18 @@ from blendjax.btt.constants import DEFAULT_TIMEOUTMS
 
 
 class RemoteEnv:
-    """Blocking client for one remote Blender environment."""
+    """Blocking client for one remote Blender environment.
 
-    def __init__(self, address, timeoutms=DEFAULT_TIMEOUTMS):
+    ``fault_policy`` (a :class:`blendjax.btt.faults.FaultPolicy`) makes
+    every RPC retry with backoff inside the policy's deadline and trips a
+    circuit breaker after consecutive failures; without one, a single
+    timeout raises (the reference behavior).  Retries re-send the request
+    — see :mod:`blendjax.btt.faults` for the non-idempotency caveat on
+    ``step``.
+    """
+
+    def __init__(self, address, timeoutms=DEFAULT_TIMEOUTMS, fault_policy=None,
+                 counters=None):
         self._ctx = zmq.Context.instance()
         self.socket = self._ctx.socket(zmq.REQ)
         self.socket.setsockopt(zmq.LINGER, 0)
@@ -36,6 +45,11 @@ class RemoteEnv:
         self.env_time = None
         self.rgb_array = None
         self.viewer = None
+        self.fault_policy = fault_policy
+        self._fault_state = (
+            fault_policy.new_state() if fault_policy is not None else None
+        )
+        self._counters = counters
 
     def reset(self):
         """Reset; returns ``(obs, info)`` (reference ``btt/env.py:47-60``)."""
@@ -69,6 +83,18 @@ class RemoteEnv:
         return None
 
     def _reqrep(self, **send_kwargs):
+        if self.fault_policy is None:
+            return self._attempt(send_kwargs)
+        return self.fault_policy.run(
+            lambda attempt: self._attempt(send_kwargs),
+            state=self._fault_state,
+            counters=self._counters,
+            name=f"RemoteEnv {send_kwargs.get('cmd', 'rpc')}",
+        )
+
+    def _attempt(self, send_kwargs):
+        """One send+recv cycle (REQ_RELAXED keeps the socket usable for a
+        policy-driven re-send after a timeout)."""
         try:
             wire.send_message(self.socket, {**send_kwargs, "time": self.env_time})
         except zmq.Again:
@@ -104,7 +130,8 @@ def kwargs_to_cli(kwargs):
 
 
 @contextmanager
-def launch_env(scene, script, background=False, timeoutms=DEFAULT_TIMEOUTMS, **kwargs):
+def launch_env(scene, script, background=False, timeoutms=DEFAULT_TIMEOUTMS,
+               fault_policy=None, **kwargs):
     """Launch one Blender env instance and yield a connected RemoteEnv
     (reference ``btt/env.py:136-189``).  Extra kwargs become CLI flags for
     the env script (see :func:`kwargs_to_cli`)."""
@@ -120,7 +147,8 @@ def launch_env(scene, script, background=False, timeoutms=DEFAULT_TIMEOUTMS, **k
             instance_args=[kwargs_to_cli(kwargs)],
             background=background,
         ) as bl:
-            env = RemoteEnv(bl.launch_info.addresses["GYM"][0], timeoutms=timeoutms)
+            env = RemoteEnv(bl.launch_info.addresses["GYM"][0],
+                            timeoutms=timeoutms, fault_policy=fault_policy)
             yield env
     finally:
         if env is not None:

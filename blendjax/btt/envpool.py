@@ -13,10 +13,27 @@ pool step is one frame of the slowest instance, not the sum.
 initial observation (its reward is 0 and done False for that transition) —
 the standard vectorized-env contract (cf. gym vector envs), chosen so
 policy rollouts under ``jax.jit``/``vmap`` see static shapes.
+
+Fault tolerance (see docs/fault_tolerance.md): exchanges run under a
+:class:`blendjax.btt.faults.FaultPolicy` (retries with backoff, per-call
+deadline, per-env circuit breaker).  With ``quarantine=True`` (default) an
+env that exhausts its retries is *quarantined* instead of failing the
+whole batched step: it stops receiving RPCs, contributes a synthetic
+transition (last known observation, zero reward, ``done=True`` exactly
+once so trainers close the episode), and is flagged in the ``healthy``
+mask / per-env infos.  Training continues on the N-1 live envs.
+Quarantined envs are probed in the background of each ``step`` (or by a
+:class:`blendjax.btt.supervise.FleetSupervisor`) with a fresh socket and a
+``reset`` resync handshake; on success the env re-enters the pool through
+the standard autoreset contract (fresh initial obs, zero reward).  Only
+when *every* env is quarantined does ``step`` raise.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+import time
 from contextlib import contextmanager
 
 import numpy as np
@@ -26,6 +43,27 @@ from blendjax import wire
 from blendjax.btt.collate import collate
 from blendjax.btt.constants import DEFAULT_TIMEOUTMS
 from blendjax.btt.env import kwargs_to_cli
+from blendjax.btt.faults import FaultPolicy
+from blendjax.utils.timing import fleet_counters
+
+logger = logging.getLogger("blendjax")
+
+
+def _zero_like(obs):
+    """Type/shape-preserving zero observation for a quarantined env that
+    never delivered one (keeps batch collation static-shaped)."""
+    if isinstance(obs, np.ndarray):
+        return np.zeros_like(obs)
+    if isinstance(obs, dict):
+        return {k: _zero_like(v) for k, v in obs.items()}
+    if isinstance(obs, (list, tuple)):
+        seq = [_zero_like(v) for v in obs]
+        return seq if isinstance(obs, list) else tuple(seq)
+    if isinstance(obs, bool):
+        return False
+    if isinstance(obs, (int, float, complex, np.number)):
+        return type(obs)(0)
+    return obs
 
 
 class EnvPool:
@@ -37,59 +75,420 @@ class EnvPool:
         GYM endpoints, one per instance (e.g.
         ``launch_info.addresses['GYM']``).
     timeoutms: int
-        Per-socket receive timeout.
+        Per-socket receive timeout (per-attempt wait when the fault
+        policy sets no ``deadline_s``).
     autoreset: bool
         Auto-reset finished instances during ``step``.
+    fault_policy: FaultPolicy | None
+        Retry/backoff/circuit policy for exchanges and re-admission
+        probes; None installs the default :class:`FaultPolicy`.  Pass
+        ``FaultPolicy(max_retries=0)`` for strict single-attempt
+        semantics (retrying ``step`` against a slow-but-alive env can
+        advance it an extra frame — see :mod:`blendjax.btt.faults`).
+    quarantine: bool
+        Degraded mode: isolate failing envs and keep stepping the rest
+        (see module docstring).  False restores fail-whole-batch:
+        any env exhausting its retries raises ``TimeoutError`` naming it
+        (successful siblings' ``env_times`` are committed first, so a
+        partial exchange never desyncs the survivors).
+    counters: EventCounters | None
+        Fault-event sink; defaults to the process-wide
+        ``blendjax.utils.timing.fleet_counters``.
     """
 
-    def __init__(self, addresses, timeoutms=DEFAULT_TIMEOUTMS, autoreset=True):
+    def __init__(
+        self,
+        addresses,
+        timeoutms=DEFAULT_TIMEOUTMS,
+        autoreset=True,
+        fault_policy=None,
+        quarantine=True,
+        counters=None,
+    ):
         self._ctx = zmq.Context.instance()
-        self.sockets = []
-        for addr in addresses:
-            s = self._ctx.socket(zmq.REQ)
-            s.setsockopt(zmq.LINGER, 0)
-            s.setsockopt(zmq.SNDTIMEO, timeoutms * 10)
-            s.setsockopt(zmq.RCVTIMEO, timeoutms)
-            s.setsockopt(zmq.REQ_RELAXED, 1)
-            s.setsockopt(zmq.REQ_CORRELATE, 1)
-            s.connect(addr)
-            self.sockets.append(s)
-        self.num_envs = len(addresses)
+        self._addresses = list(addresses)
+        self._timeoutms = timeoutms
+        self.sockets = [self._connect(a) for a in self._addresses]
+        self.num_envs = len(self._addresses)
         self.env_times = [None] * self.num_envs
         self._needs_reset = np.ones(self.num_envs, dtype=bool)
         self.autoreset = autoreset
+        self.quarantine = quarantine
+        self.policy = fault_policy if fault_policy is not None else FaultPolicy()
+        self.counters = counters if counters is not None else fleet_counters
+        # quarantine state; _lock guards every transition (step runs on the
+        # training thread, probes may run from a supervisor thread)
+        self._lock = threading.RLock()
+        self._exchanging = set()  # envs whose sockets a step/reset is using
+        self._quarantined = np.zeros(self.num_envs, dtype=bool)
+        self._states = [self.policy.new_state(i) for i in range(self.num_envs)]
+        self._probe = [None] * self.num_envs  # per-env re-admission attempt
+        self._fresh = [None] * self.num_envs  # unconsumed resync reset reply
+        self._pending_done = set()  # envs owing their one quarantine done=True
+        self._last_obs = [None] * self.num_envs
+
+    def _connect(self, addr):
+        s = self._ctx.socket(zmq.REQ)
+        s.setsockopt(zmq.LINGER, 0)
+        s.setsockopt(zmq.SNDTIMEO, self._timeoutms * 10)
+        s.setsockopt(zmq.RCVTIMEO, self._timeoutms)
+        s.setsockopt(zmq.REQ_RELAXED, 1)
+        s.setsockopt(zmq.REQ_CORRELATE, 1)
+        s.connect(addr)
+        return s
+
+    # -- health surface -----------------------------------------------------
+
+    @property
+    def healthy(self):
+        """Boolean mask, True for envs currently serving real transitions."""
+        with self._lock:
+            return ~self._quarantined.copy()
+
+    @property
+    def quarantined(self):
+        with self._lock:
+            return self._quarantined.copy()
 
     # -- pipelined RPC ------------------------------------------------------
 
-    def _exchange(self, requests):
-        """Send one request per env, then collect all replies (pipelined)."""
-        for sock, req in zip(self.sockets, requests):
+    def _recv_wait_ms(self):
+        """Per-attempt recv wait: the policy deadline when set (so one
+        slow env cannot eat the whole socket timeout per attempt), else
+        the socket timeout."""
+        if self.policy.deadline_s is not None:
+            return max(1, int(self.policy.deadline_s * 1000))
+        return self._timeoutms
+
+    def _exchange(self, requests, indices=None):
+        """Pipelined exchange over env ``indices`` (default: all).
+
+        Sends every request, then collects replies; an env that fails its
+        send or exhausts its recv retries lands in ``failed`` instead of
+        aborting the exchange, and every *successful* reply commits its
+        ``env_times`` entry regardless of sibling failures (a partial
+        exchange must never desync the survivors).
+
+        Returns ``(replies, failed)``: ``replies`` maps env index to its
+        reply dict, ``failed`` maps env index to the error string.
+        """
+        if indices is None:
+            indices = list(range(self.num_envs))
+        # socket mutual exclusion with the probe machinery, both ways: an
+        # env quarantined between the caller's snapshot and this point may
+        # have a probe mid-flight on its (re-dialed) socket, and a probe
+        # must never touch a socket this exchange is using.  Quarantined /
+        # busy-probed envs are failed up front without an RPC.
+        with self._lock:
+            blocked = {
+                i for i in indices
+                if self._quarantined[i]
+                or (self._probe[i] is not None and self._probe[i].get("busy"))
+            }
+            self._exchanging = set(indices) - blocked
+        try:
+            return self._exchange_locked_out(requests, indices, blocked)
+        finally:
+            with self._lock:
+                self._exchanging = set()
+
+    def _exchange_locked_out(self, requests, indices, blocked=()):
+        reqs = dict(zip(indices, requests))
+        replies, failed = {}, {}
+        awaiting = []
+        for i in indices:
+            if i in blocked:
+                failed[i] = f"environment {i} is quarantined"
+                continue
+            if self._states[i].circuit_open():
+                # the breaker protects strict-mode pools too: a dead env
+                # stops costing (max_retries+1) recv waits per step
+                self.counters.incr("circuit_rejections")
+                failed[i] = (
+                    f"environment {i} circuit open after "
+                    f"{self._states[i].consecutive_failures} consecutive "
+                    "failures"
+                )
+                continue
             try:
-                wire.send_message(sock, req)
+                wire.send_message(self.sockets[i], reqs[i])
+                awaiting.append(i)
             except zmq.Again:
-                raise TimeoutError("Failed to send to remote environment") from None
-        replies = []
-        for i, sock in enumerate(self.sockets):
+                self.counters.incr("timeouts")
+                self._states[i].record_failure(self.counters)
+                failed[i] = f"send to environment {i} timed out"
+        # recv phase: one poller over every awaiting socket, in rounds —
+        # attempt r waits at most one recv budget for ALL still-pending
+        # envs together, so K simultaneously dead envs stall a step for
+        # ~(max_retries+1) recv waits total, not K times that
+        wait_ms = self._recv_wait_ms()
+        pending = set(awaiting)
+        poller = zmq.Poller()
+        for i in pending:
+            poller.register(self.sockets[i], zmq.POLLIN)
+        for attempt in range(self.policy.max_retries + 1):
+            deadline = time.monotonic() + wait_ms / 1e3
+            while pending:
+                remaining_ms = int((deadline - time.monotonic()) * 1000)
+                if remaining_ms <= 0:
+                    break
+                events = dict(poller.poll(remaining_ms))
+                if not events:
+                    break
+                for i in list(pending):
+                    sock = self.sockets[i]
+                    if not (events.get(sock, 0) & zmq.POLLIN):
+                        continue
+                    try:
+                        ddict = wire.recv_message(sock)
+                    except Exception:
+                        # a garbled/unpicklable reply is an env fault,
+                        # not a pool crash: discard it and let the retry
+                        # / quarantine machinery handle the env
+                        logger.warning(
+                            "env %d: malformed reply discarded", i,
+                            exc_info=True,
+                        )
+                        continue
+                    self.env_times[i] = ddict.get("time")
+                    self._states[i].record_success()
+                    replies[i] = ddict
+                    poller.unregister(sock)
+                    pending.discard(i)
+            if not pending:
+                break
+            for i in pending:
+                self.counters.incr("timeouts")
+                self._states[i].record_failure(self.counters)
+            if attempt >= self.policy.max_retries:
+                for i in pending:
+                    self.counters.incr("failures")
+                    failed[i] = (
+                        f"no response from environment {i} within timeout"
+                    )
+                break
+            # one shared backoff per round (the slowest of the pending
+            # envs' jittered delays), then re-send to all of them —
+            # REQ_RELAXED allows it, REQ_CORRELATE drops the stale reply
+            self.counters.incr("retries", len(pending))
+            delay = max(
+                self._states[i].backoff(attempt + 1) for i in pending
+            )
+            if delay > 0:
+                time.sleep(delay)
+            for i in list(pending):
+                try:
+                    wire.send_message(self.sockets[i], reqs[i])
+                except zmq.Again:
+                    self.counters.incr("failures")
+                    failed[i] = f"send to environment {i} timed out"
+                    poller.unregister(self.sockets[i])
+                    pending.discard(i)
+        return replies, failed
+
+    def _fail_or_quarantine(self, failed):
+        """Route exchange failures: quarantine mode isolates each failed
+        env; strict mode raises (after the successes were committed)."""
+        if not failed:
+            return
+        if not self.quarantine:
+            raise TimeoutError("; ".join(failed.values()))
+        for i, reason in failed.items():
+            self.quarantine_env(i, reason=reason)
+
+    # -- quarantine & re-admission ------------------------------------------
+
+    def quarantine_env(self, i, reason="unresponsive"):
+        """Isolate env ``i``: no more RPCs until a probe re-admits it.
+        Idempotent; safe from any thread (the supervisor calls this
+        proactively on producer death, ahead of any timeout)."""
+        with self._lock:
+            if self._quarantined[i]:
+                return
+            self._quarantined[i] = True
+            self._pending_done.add(i)
+            self._fresh[i] = None
+            self._probe[i] = {"active": False, "sent": False, "started": 0.0,
+                              "attempts": 0, "next_at": 0.0}
+            self.counters.incr("quarantines")
+        logger.warning("env %d quarantined: %s", i, reason)
+
+    def notify_respawn(self, i):
+        """The producer behind env ``i`` was restarted: drop the backoff
+        and circuit state so the next probe runs immediately on a fresh
+        socket (called by :class:`~blendjax.btt.supervise.FleetSupervisor`
+        after a watchdog respawn)."""
+        with self._lock:
+            if not self._quarantined[i]:
+                return
+            self._states[i] = self.policy.new_state(i)
+            p = self._probe[i]
+            if p is not None and p.get("busy"):
+                # a probe is mid-flight on this env's socket from another
+                # thread: don't replace its attempt record (a fresh one
+                # would let a second probe redial — and close — the
+                # socket in use); just clear the backoff so the next
+                # attempt after it resolves runs immediately
+                p.update(next_at=0.0, attempts=0)
+            else:
+                self._probe[i] = {"active": False, "sent": False,
+                                  "started": 0.0, "attempts": 0,
+                                  "next_at": 0.0}
+
+    def probe(self, block_ms=0):
+        """Attempt re-admission of quarantined envs (backoff/circuit
+        gated).  Each attempt is a three-phase async handshake spread over
+        successive calls — dial a fresh socket, send a ``reset`` resync
+        once the connection is writable, collect the fresh initial
+        observation — so ``block_ms=0`` (the in-``step`` mode) never
+        blocks the training loop; positive ``block_ms`` bounds each wait
+        (supervisor heal loop).  An attempt that exceeds the policy
+        deadline fails, feeds the circuit breaker, and backs off.
+        Returns the list of env indices re-admitted by this call."""
+        readmitted = []
+        deadline_s = (
+            self.policy.deadline_s
+            if self.policy.deadline_s is not None
+            else self._timeoutms / 1e3
+        )
+        # phase 1 (locked, non-blocking): pick due probes, dial fresh
+        # sockets, and mark each one busy so concurrent probe callers
+        # (training step vs supervisor heal thread) never share a socket
+        work = []
+        with self._lock:
+            if not self.sockets:
+                return readmitted  # pool closed (a heal tick may race it)
+            now = time.monotonic()
+            for i in np.flatnonzero(self._quarantined):
+                i = int(i)
+                st, p = self._states[i], self._probe[i]
+                if p is None or p.get("busy") or i in self._exchanging:
+                    continue
+                if st.circuit_open(now) or now < p["next_at"]:
+                    continue
+                if not p.get("active"):
+                    # reconnect: a fresh REQ drops any half-done request
+                    # cycle and re-dials the (possibly re-bound) endpoint
+                    self.sockets[i].close(0)
+                    self.sockets[i] = self._connect(self._addresses[i])
+                    p.update(active=True, sent=False, started=now)
+                p["busy"] = True
+                work.append((i, self.sockets[i], p))
+        # phase 2 (unlocked): the blocking polls — a dead endpoint must
+        # not starve step()/reset() of the pool lock while we wait on it
+        for i, sock, p in work:
+            reply, malformed = None, False
             try:
-                ddict = wire.recv_message(sock)
-            except zmq.Again:
-                raise TimeoutError(
-                    f"No response from environment {i} within timeout"
-                ) from None
-            self.env_times[i] = ddict.get("time")
-            replies.append(ddict)
-        return replies
+                if not p["sent"] and sock.poll(block_ms, zmq.POLLOUT):
+                    try:
+                        wire.send_message(
+                            sock, {"cmd": "reset", "time": None},
+                            flags=zmq.NOBLOCK,
+                        )
+                        p["sent"] = True
+                    except zmq.Again:
+                        pass  # connection raced away; retry within deadline
+                if p["sent"] and sock.poll(block_ms, zmq.POLLIN):
+                    try:
+                        reply = wire.recv_message(sock)
+                    except Exception:
+                        malformed = True
+                        logger.warning(
+                            "env %d: malformed resync reply discarded", i,
+                            exc_info=True,
+                        )
+            finally:
+                # phase 3 (locked): apply the outcome
+                with self._lock:
+                    p["busy"] = False
+                    if reply is not None and self._quarantined[i]:
+                        self.env_times[i] = reply.get("time")
+                        self._fresh[i] = reply
+                        self._quarantined[i] = False
+                        self._needs_reset[i] = False
+                        self._probe[i] = None
+                        # an unsurfaced quarantine done stays pending:
+                        # step() emits the interrupted episode's terminal
+                        # transition before consuming the resync obs
+                        self._states[i].record_success()
+                        self.counters.incr("readmissions")
+                        readmitted.append(i)
+                        logger.warning("env %d re-admitted after resync", i)
+                    elif malformed or (
+                        time.monotonic() - p["started"] >= deadline_s
+                    ):
+                        self.counters.incr("timeouts")
+                        self._probe_failed(i, time.monotonic())
+        return readmitted
+
+    def _probe_failed(self, i, now):
+        """One re-admission attempt failed: back off (policy jitter) and
+        schedule a fresh-socket retry; consecutive failures feed the
+        circuit breaker so a permanently-dead endpoint stops being dialed
+        every step."""
+        p = self._probe[i]
+        p["attempts"] += 1
+        p["active"] = False
+        self._states[i].record_failure(self.counters)
+        p["next_at"] = now + self._states[i].backoff(p["attempts"])
+
+    # -- batched API --------------------------------------------------------
 
     def reset(self):
-        """Reset all instances; returns ``(batched_obs, infos)``."""
-        replies = self._exchange(
-            [{"cmd": "reset", "time": t} for t in self.env_times]
+        """Reset all live instances; returns ``(batched_obs, infos)``.
+
+        Quarantined envs contribute their last known (or zero) observation
+        with ``info['healthy'] = False``; they rejoin via the re-admission
+        handshake, which itself performs a ``reset``.  Raises when every
+        env is quarantined.
+        """
+        self.probe(block_ms=0)
+        with self._lock:
+            self._fresh = [None] * self.num_envs  # superseded by this reset
+            live = [i for i in range(self.num_envs) if not self._quarantined[i]]
+        if not live:
+            raise TimeoutError("all environments are quarantined")
+        if not self.quarantine and len(live) < self.num_envs:
+            # strict mode: a supervisor-quarantined env fails the call
+            # instead of contributing a synthetic slot
+            raise TimeoutError(
+                "environment(s) "
+                f"{[i for i in range(self.num_envs) if i not in live]} are "
+                "quarantined (strict mode: no degraded batches)"
+            )
+        replies, failed = self._exchange(
+            [{"cmd": "reset", "time": self.env_times[i]} for i in live],
+            indices=live,
         )
-        self._needs_reset[:] = False
-        obs = [r.pop("obs") for r in replies]
-        for r in replies:
-            r.pop("rgb_array", None)
-        return collate(obs), replies
+        self._fail_or_quarantine(failed)
+        if not replies:
+            # the exchange in which the LAST live envs fail must raise,
+            # not return an all-synthetic batch (which, before any env
+            # ever delivered an obs, couldn't even be shaped correctly)
+            raise TimeoutError(
+                "all environments are quarantined: "
+                + "; ".join(failed.values())
+            )
+        # commit every live obs BEFORE assembly so a quarantined slot can
+        # synthesize a shape-matched placeholder even on the first batch
+        for j, r in replies.items():
+            self._last_obs[j] = r.pop("obs")
+        obs, infos = [], []
+        for i in range(self.num_envs):
+            r = replies.get(i)
+            if r is not None:
+                self._needs_reset[i] = False
+                # an explicit reset IS the episode boundary; any owed
+                # quarantine done for this env is thereby delivered
+                self._pending_done.discard(i)
+                r.pop("rgb_array", None)
+                r["healthy"] = True
+                obs.append(self._last_obs[i])
+            else:
+                obs.append(self._synthetic_obs(i))
+                r = {"healthy": False, "quarantined": True}
+            infos.append(r)
+        return collate(obs), infos
 
     def step(self, actions):
         """Step all instances with a length-N batch of actions.
@@ -97,39 +496,162 @@ class EnvPool:
         Returns ``(obs, rewards, dones, infos)`` with obs collated and
         rewards/dones as float32/bool arrays.  With ``autoreset``,
         instances that reported done on the previous step are reset now.
+
+        Under quarantine, isolated envs return synthetic transitions
+        (``info['healthy'] = False``) and freshly re-admitted envs return
+        their resync observation through the autoreset contract
+        (``info['readmitted'] = True``, zero reward).
         """
         if len(actions) != self.num_envs:
             raise ValueError(f"expected {self.num_envs} actions, got {len(actions)}")
-        requests = []
+        self.probe(block_ms=0)
+        with self._lock:
+            quarantined = self._quarantined.copy()
+            fresh, owe_done = {}, set()
+            for i in range(self.num_envs):
+                if self._fresh[i] is not None and not quarantined[i]:
+                    if i in self._pending_done:
+                        # re-admission won the race with the training
+                        # loop: the interrupted episode's terminal
+                        # transition (done=True on the last real obs) must
+                        # still surface exactly once — emit it THIS step
+                        # and hold the fresh resync obs for the next one
+                        self._pending_done.discard(i)
+                        owe_done.add(i)
+                    else:
+                        fresh[i] = self._fresh[i]
+                        self._fresh[i] = None
+        if quarantined.all():
+            raise TimeoutError("all environments are quarantined")
+        if not self.quarantine and quarantined.any():
+            # strict mode never serves synthetic transitions — a
+            # supervisor (or caller) may still quarantine_env() on
+            # producer death, and the strict caller opted to fail instead
+            # of training on fabricated data
+            raise TimeoutError(
+                "environment(s) "
+                f"{[int(i) for i in np.flatnonzero(quarantined)]} are "
+                "quarantined (strict mode: no degraded batches)"
+            )
+        send_idx, requests = [], []
         for i, action in enumerate(actions):
+            if quarantined[i] or i in fresh or i in owe_done:
+                continue
+            send_idx.append(i)
             if self.autoreset and self._needs_reset[i]:
                 requests.append({"cmd": "reset", "time": self.env_times[i]})
             else:
                 requests.append(
                     {"cmd": "step", "action": action, "time": self.env_times[i]}
                 )
-        replies = self._exchange(requests)
+        replies, failed = self._exchange(requests, indices=send_idx)
+        self._fail_or_quarantine(failed)
+        if not replies and not fresh and not owe_done:
+            # every remaining live env failed in THIS call: raise rather
+            # than hand back a batch with no real transition in it
+            raise TimeoutError(
+                "all environments are quarantined: "
+                + "; ".join(failed.values())
+            )
+        with self._lock:
+            quarantined = self._quarantined.copy()
+            # an env owes its one quarantine done=True only while it is
+            # actually served synthetically: a reply that raced the
+            # quarantine keeps its real transition, and a slot being served
+            # from `fresh`/`owe_done` this step emits its own bookkeeping —
+            # in every excluded case the pending done survives and fires on
+            # that env's next synthetic step instead of vanishing
+            q_done = {
+                i for i in self._pending_done
+                if quarantined[i]
+                and i not in replies
+                and i not in fresh
+                and i not in owe_done
+            }
+            self._pending_done -= q_done
 
-        obs, rewards, dones = [], [], []
-        for i, r in enumerate(replies):
-            was_reset = self.autoreset and self._needs_reset[i]
-            obs.append(r.pop("obs"))
-            rewards.append(0.0 if was_reset else float(r.pop("reward", 0.0)))
-            done = False if was_reset else bool(r.pop("done", False))
-            dones.append(done)
-            self._needs_reset[i] = done
-            r.pop("rgb_array", None)
+        # commit every live obs BEFORE assembly so a quarantined slot can
+        # synthesize a shape-matched placeholder even on the first batch
+        for j, r in replies.items():
+            self._last_obs[j] = r.pop("obs")
+        for j, f in fresh.items():
+            self._last_obs[j] = f.pop("obs")
+        obs, rewards, dones, infos = [], [], [], []
+        for i in range(self.num_envs):
+            r = replies.get(i)
+            if i in fresh:
+                f = fresh[i]
+                f.pop("rgb_array", None)
+                f.update(healthy=True, readmitted=True)
+                obs.append(self._last_obs[i])
+                rewards.append(0.0)
+                dones.append(False)
+                self._needs_reset[i] = False
+                infos.append(f)
+            elif r is not None:
+                was_reset = self.autoreset and self._needs_reset[i]
+                obs.append(self._last_obs[i])
+                rewards.append(0.0 if was_reset else float(r.pop("reward", 0.0)))
+                done = False if was_reset else bool(r.pop("done", False))
+                dones.append(done)
+                self._needs_reset[i] = done
+                r.pop("rgb_array", None)
+                r["healthy"] = True
+                infos.append(r)
+            elif i in owe_done:
+                # terminal close-out of the interrupted episode: last real
+                # obs, done=True; the env is healthy again and its held
+                # resync obs arrives next step via the fresh branch
+                obs.append(self._synthetic_obs(i))
+                rewards.append(0.0)
+                dones.append(True)
+                self._needs_reset[i] = False
+                infos.append(
+                    {"healthy": True, "quarantined": True, "interrupted": True}
+                )
+            else:
+                obs.append(self._synthetic_obs(i))
+                rewards.append(0.0)
+                dones.append(i in q_done)
+                self._needs_reset[i] = False
+                infos.append({"healthy": False, "quarantined": True})
         return (
             collate(obs),
             np.asarray(rewards, np.float32),
             np.asarray(dones, bool),
-            replies,
+            infos,
         )
 
+    def _synthetic_obs(self, i):
+        """Placeholder observation for a quarantined slot: the env's last
+        delivered obs, else a zero of any sibling's obs (static batch
+        shape either way; live obs are committed to ``_last_obs`` before
+        assembly, so a template exists from the very first batch).  The
+        bare-0.0 fallback is only reachable when no env has ever
+        delivered an observation."""
+        if self._last_obs[i] is not None:
+            return self._last_obs[i]
+        for template in self._last_obs:
+            if template is not None:
+                return _zero_like(template)
+        return 0.0
+
     def close(self):
-        for s in self.sockets:
-            s.close(0)
-        self.sockets = []
+        # detach the socket list first (new probes see a closed pool),
+        # then wait out any probe mid-flight in its unlocked poll phase —
+        # closing a zmq socket under another thread's poll is undefined
+        # behavior, and probe phases are bounded by block_ms
+        with self._lock:
+            socks, self.sockets = self.sockets, []
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not any(p and p.get("busy") for p in self._probe):
+                    break
+            time.sleep(0.01)
+        with self._lock:
+            for s in socks:
+                s.close(0)
 
     def __enter__(self):
         return self
@@ -148,6 +670,9 @@ def launch_env_pool(
     timeoutms=DEFAULT_TIMEOUTMS,
     autoreset=True,
     start_port=11000,
+    fault_policy=None,
+    quarantine=True,
+    counters=None,
     **kwargs,
 ):
     """Launch N Blender env instances and yield a connected EnvPool.
@@ -172,6 +697,9 @@ def launch_env_pool(
             bl.launch_info.addresses["GYM"],
             timeoutms=timeoutms,
             autoreset=autoreset,
+            fault_policy=fault_policy,
+            quarantine=quarantine,
+            counters=counters,
         )
         try:
             yield pool

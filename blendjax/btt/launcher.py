@@ -252,6 +252,25 @@ class BlenderLauncher:
         self.launch_info = LaunchInfo(addresses, commands, processes=processes)
         return self
 
+    def respawn(self, idx):
+        """Respawn instance ``idx`` with its original command line (same
+        addresses, same seed — shm ring names carry the launch nonce, so
+        the reader's generation-reopen elasticity keeps working).  Used by
+        :class:`blendjax.btt.watchdog.FleetWatchdog` restarts; callable
+        directly for manual healing.  Returns the new process."""
+        info = self.launch_info
+        if info is None:
+            raise RuntimeError("Not launched.")
+        new = subprocess.Popen(
+            info.commands[idx],
+            shell=False,
+            env=child_env(),
+            **popen_group_kwargs(),
+        )
+        info.processes[idx] = new
+        logger.info("Respawned instance %d as pid %d", idx, new.pid)
+        return new
+
     def assert_alive(self):
         """Raise if any launched process has exited (reference ``:166-171``)."""
         if self.launch_info is None:

@@ -60,14 +60,23 @@ class TransferGate:
         must not freeze the feed forever.  When it fires, a warning is
         logged once per stall episode (re-armed each time the gate next
         opens, so a later unrelated stall — e.g. after a relay recovery —
-        is visible too; ADVICE r4).
+        is visible too; ADVICE r4) and the ``transfer_gate_backstops``
+        fleet counter increments (every fire: the counter is the
+        quantitative record, the log is the narrative one).
+    counters: EventCounters | None
+        Backstop-fire sink; defaults to the process-wide
+        ``blendjax.utils.timing.fleet_counters`` so
+        ``FleetSupervisor.health()`` sees the fires.
     """
 
-    def __init__(self, timeout=5.0):
+    def __init__(self, timeout=5.0, counters=None):
+        from blendjax.utils.timing import fleet_counters
+
         self._cond = threading.Condition()
         self._inflight = 0
         self.timeout = timeout
         self._warned = False
+        self._counters = counters if counters is not None else fleet_counters
 
     def wait(self, timeout=None, stop=None):
         """Feed-worker side: block while any transfer is in flight.
@@ -85,6 +94,7 @@ class TransferGate:
                     return False
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    self._counters.incr("transfer_gate_backstops")
                     if not self._warned:
                         self._warned = True
                         log.warning(

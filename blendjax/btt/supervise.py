@@ -1,0 +1,223 @@
+"""Supervised restart-and-resync: the fleet's self-healing control loop.
+
+:class:`blendjax.btt.watchdog.FleetWatchdog` respawns dead producers, and
+:class:`blendjax.btt.envpool.EnvPool` quarantines/re-admits unresponsive
+envs — but the reference architecture (and PR 1's port of it) left those
+two halves unconnected: a respawned producer sat idle until the consumer
+happened to time out into it.  ``FleetSupervisor`` closes the loop:
+
+- on producer **death** it immediately quarantines the matching pool env
+  (no waiting for an RPC timeout into a dead peer) and counts the event;
+- on **respawn** it clears that env's backoff/circuit state and drives
+  the re-admission handshake from its own heal thread, so envs rejoin
+  within the fault policy's deadline even when the training loop is busy;
+- **dataset streams** need no RPC resync (tcp consumers keep their
+  connect-mode sockets; shm readers remap the new ring generation via the
+  rc -4 reopen path in :mod:`blendjax.native.ring`), but the supervisor
+  verifies the remap through registered health checks and reports it;
+- :meth:`health` snapshots the whole story — deaths, restarts, retries,
+  quarantines, timeouts, re-admissions, circuit trips, stream timeouts,
+  TransferGate backstop fires — from the shared
+  :class:`blendjax.utils.timing.EventCounters`.
+
+Usage::
+
+    counters = EventCounters()
+    pool = EnvPool(addresses, fault_policy=policy, counters=counters)
+    with FleetSupervisor(launcher, pool=pool, interval=0.5) as sup:
+        for step in range(n):
+            obs, rew, done, infos = pool.step(actions)   # N-1 under faults
+        assert sup.health()["quarantines"] == 0          # clean run
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from blendjax.btt.watchdog import FleetWatchdog
+from blendjax.utils.timing import FLEET_EVENTS, fleet_counters
+
+logger = logging.getLogger("blendjax")
+
+
+class FleetSupervisor:
+    """Ties fleet restarts to consumer healing, with one health surface.
+
+    Params
+    ------
+    launcher: BlenderLauncher
+        A launcher inside its context (``launch_info`` populated).
+    pool: EnvPool | None
+        Pool to quarantine/re-admit in lockstep with producer deaths.
+        Instance ``i`` of the launcher must serve env ``i`` of the pool
+        (the natural outcome of building the pool from
+        ``launch_info.addresses``).
+    interval: float
+        Watchdog poll period, seconds.
+    restart: bool
+        Respawn dead producers (off = detect/quarantine only).
+    counters: EventCounters | None
+        Event sink; defaults to the pool's counters (so pool-side retry/
+        quarantine events and supervisor-side death/restart events land
+        in one snapshot), else the process-wide ``fleet_counters``.
+    on_death: callable | None
+        Extra ``on_death(index, exit_code)`` user hook, invoked after the
+        supervisor's own handling.
+    heal_interval: float
+        Heal-thread cadence, seconds (each tick drives pending
+        re-admission probes).
+    """
+
+    def __init__(
+        self,
+        launcher,
+        pool=None,
+        interval=1.0,
+        restart=True,
+        counters=None,
+        on_death=None,
+        heal_interval=0.05,
+    ):
+        self.launcher = launcher
+        self.pool = pool
+        if counters is None:
+            counters = pool.counters if pool is not None else fleet_counters
+        self.counters = counters
+        self._user_on_death = on_death
+        self.watchdog = FleetWatchdog(
+            launcher, interval=interval, on_death=self._on_death,
+            restart=restart,
+        )
+        self.heal_interval = heal_interval
+        self._stop = threading.Event()
+        self._event = threading.Event()  # pulses on any state change
+        self._heal_thread = None
+        self._checks = {}
+        self._down = set()  # instances reported dead, respawn still owed
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        if self._heal_thread is not None:
+            raise RuntimeError("supervisor already started")
+        self.watchdog.start()
+        self._heal_thread = threading.Thread(
+            target=self._heal_loop, daemon=True, name="bjx-supervisor"
+        )
+        self._heal_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.watchdog.stop()
+        if self._heal_thread is not None:
+            self._heal_thread.join(timeout=self.heal_interval + 5)
+            self._heal_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- death -> quarantine -> resync --------------------------------------
+
+    def _on_death(self, idx, code):
+        # the watchdog reports a death with a FAILED respawn once, then
+        # re-fires when a later respawn succeeds; distinguish via its own
+        # death log (the callback runs synchronously after the append) so
+        # deaths count physical deaths and restarts count real respawns
+        rec = next(
+            (d for d in reversed(self.watchdog.deaths) if d[0] == idx), None
+        )
+        respawned = bool(rec and rec[2])
+        if respawned and idx in self._down:
+            self._down.discard(idx)  # same death, respawn finally landed
+        else:
+            self.counters.incr("deaths")
+        if self.pool is not None and idx < self.pool.num_envs:
+            # proactive: stop RPCing a peer known to be dead instead of
+            # discovering it one timeout at a time
+            self.pool.quarantine_env(
+                idx, reason=f"producer died (exit {code})"
+            )
+        if respawned:
+            self.counters.incr("restarts")
+            if self.pool is not None and idx < self.pool.num_envs:
+                # the endpoint is coming back: drop backoff/circuit state
+                # so the heal loop re-dials it immediately
+                self.pool.notify_respawn(idx)
+        elif self.watchdog.restart:
+            self._down.add(idx)  # respawn failed; watchdog retries it
+        self._event.set()
+        if self._user_on_death is not None:
+            self._user_on_death(idx, code)
+
+    def _heal_loop(self):
+        while not self._stop.wait(self.heal_interval):
+            pool = self.pool
+            if pool is None:
+                continue
+            try:
+                if pool.quarantined.any() and pool.probe(block_ms=20):
+                    self._event.set()
+            except Exception:
+                # the heal loop shares the watchdog's prime directive:
+                # it must outlive whatever it is healing
+                logger.exception("supervisor heal tick failed")
+
+    # -- stream verification --------------------------------------------------
+
+    def add_health_check(self, name, fn):
+        """Register ``fn() -> bool`` evaluated by :meth:`health` and
+        required by :meth:`await_healthy` — e.g. a dataset-stream remap
+        probe (``lambda: reader.reconnects >= 1`` for the shm rc -4 path,
+        or a freshness check on the consuming iterator)."""
+        self._checks[name] = fn
+
+    # -- observability ------------------------------------------------------
+
+    def health(self):
+        """One snapshot of fleet health: every canonical fault counter
+        (zero-filled, see ``FLEET_EVENTS``), watchdog liveness, the
+        pool's quarantine state, and registered stream checks."""
+        h = dict.fromkeys(FLEET_EVENTS, 0)
+        h.update(self.counters.snapshot())
+        h["alive"] = self.watchdog.alive
+        if self.pool is not None:
+            mask = self.pool.healthy
+            h["num_envs"] = int(mask.size)
+            h["healthy_envs"] = int(mask.sum())
+        h["checks"] = {name: bool(fn()) for name, fn in self._checks.items()}
+        return h
+
+    def _await(self, cond, timeout):
+        """Bounded wait for ``cond()`` — event-pulsed, no bare sleeps."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if cond():
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            self._event.clear()
+            self._event.wait(min(0.05, remaining))
+
+    def await_deaths(self, n=1, timeout=30.0):
+        """Block until ``n`` producer deaths have been processed (their
+        envs quarantined, respawns issued).  True on success."""
+        return self._await(lambda: self.counters.get("deaths") >= n, timeout)
+
+    def await_healthy(self, timeout=30.0):
+        """Block until every pool env is healthy and every registered
+        check passes.  True on success, False on timeout."""
+
+        def cond():
+            if self.pool is not None and not self.pool.healthy.all():
+                return False
+            return all(bool(fn()) for fn in self._checks.values())
+
+        return self._await(cond, timeout)

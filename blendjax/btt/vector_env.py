@@ -89,8 +89,15 @@ if _gym is not None:
 
         def step(self, actions):
             obs, rewards, dones, infos = self._pool.step(list(actions))
-            terminations = np.asarray(dones, dtype=bool)
-            truncations = np.zeros(self.num_envs, dtype=bool)
+            dones = np.asarray(dones, dtype=bool)
+            # a quarantine done is an episode cut short (producer died /
+            # hung), not a task-terminal state: gymnasium-conformant
+            # trainers must keep bootstrapping V(s') there, so it routes
+            # to truncations, never terminations
+            truncations = np.array(
+                [bool(info.get("quarantined")) for info in infos], dtype=bool
+            ) & dones
+            terminations = dones & ~truncations
             return (
                 self._as_batched(obs),
                 rewards,

@@ -15,7 +15,6 @@ change and remaps the new generation (rc -4 reopen path).
 from __future__ import annotations
 
 import logging
-import subprocess
 import threading
 
 logger = logging.getLogger("blendjax")
@@ -86,23 +85,50 @@ class FleetWatchdog:
                 already = any(d[0] == idx and not d[2] for d in self.deaths)
                 restarted = False
                 if self.restart:
-                    from blendjax.btt.launcher import child_env, popen_group_kwargs
-
-                    new = subprocess.Popen(
-                        info.commands[idx],
-                        env=child_env(),
-                        **popen_group_kwargs(),
-                    )
-                    info.processes[idx] = new
-                    restarted = True
-                    logger.warning(
-                        "instance %d died (exit %s); restarted as pid %d",
-                        idx, code, new.pid,
-                    )
+                    try:
+                        new = self.launcher.respawn(idx)
+                    except Exception:
+                        # a failed respawn (transient ENOMEM, unavailable
+                        # executable) must not kill the watchdog thread:
+                        # the instance is still dead next poll, so the
+                        # respawn retries every interval — but the death
+                        # itself is still reported (once, below) so
+                        # supervisors can quarantine/alert while the
+                        # producer stays down.  A later successful respawn
+                        # appends a second, restarted=True record (and
+                        # re-fires on_death, which re-arms the consumer
+                        # resync).
+                        logger.exception(
+                            "respawn of instance %d failed; retrying on "
+                            "the next poll", idx,
+                        )
+                        if already:
+                            continue
+                    else:
+                        restarted = True
+                        # resolve any earlier respawn-failed record so a
+                        # future death of this instance reports again
+                        self.deaths = [
+                            d for d in self.deaths
+                            if not (d[0] == idx and not d[2])
+                        ]
+                        logger.warning(
+                            "instance %d died (exit %s); restarted as "
+                            "pid %d", idx, code, new.pid,
+                        )
                 elif not already:
                     logger.warning("instance %d died (exit %s)", idx, code)
                 else:
                     continue
                 self.deaths.append((idx, code, restarted))
                 if self.on_death is not None:
-                    self.on_death(idx, code)
+                    # an exception in user callback code must not kill the
+                    # watchdog thread — it is exactly the component that
+                    # must survive everything else failing
+                    try:
+                        self.on_death(idx, code)
+                    except Exception:
+                        logger.exception(
+                            "watchdog on_death callback failed for "
+                            "instance %d (watchdog keeps running)", idx,
+                        )

@@ -20,6 +20,7 @@ SeqFormer stack.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -31,6 +32,8 @@ import optax
 
 from blendjax.models import policy
 from blendjax.models.train import TrainState, make_train_step
+
+log = logging.getLogger("blendjax")
 
 
 class ActorLearner:
@@ -103,6 +106,8 @@ class ActorLearner:
         self._thread = None
         self._actor_error = None
         self._env_steps = 0
+        self._unhealthy_env_steps = 0
+        self._degraded = False
 
     # -- actor side --------------------------------------------------------
 
@@ -122,9 +127,28 @@ class ActorLearner:
                 for _ in range(self.rollout_len):
                     action, _logp, rng = self._sample(params, rng, obs)
                     action = np.asarray(action)
-                    nobs, rew, done, _ = self.pool.step(
+                    nobs, rew, done, infos = self.pool.step(
                         self.action_map(action)
                     )
+                    # degraded-mode accounting: quarantined slots return
+                    # synthetic zero-reward transitions (see
+                    # docs/fault_tolerance.md) — surface how much of the
+                    # rollout they make up instead of absorbing it silently
+                    unhealthy = sum(
+                        1 for inf in infos if not inf.get("healthy", True)
+                    )
+                    if unhealthy:
+                        self._unhealthy_env_steps += unhealthy
+                        if not self._degraded:
+                            self._degraded = True
+                            log.warning(
+                                "actor rollout degraded: %d/%d envs "
+                                "quarantined (synthetic transitions in "
+                                "the batch)", unhealthy, self.pool.num_envs,
+                            )
+                    elif self._degraded:
+                        self._degraded = False
+                        log.warning("actor rollout healthy again")
                     seg_obs.append(obs)
                     seg_act.append(action)
                     seg_rew.append(np.asarray(rew, np.float32))
@@ -173,6 +197,8 @@ class ActorLearner:
         self._stop = threading.Event()
         self._actor_error = None
         self._env_steps = 0
+        self._unhealthy_env_steps = 0
+        self._degraded = False
         try:
             while True:
                 self._q.get_nowait()
@@ -221,6 +247,7 @@ class ActorLearner:
         return {
             "updates": len(losses),
             "env_steps": self._env_steps,
+            "unhealthy_env_steps": self._unhealthy_env_steps,
             "env_steps_per_sec": round(self._env_steps / elapsed, 1),
             "updates_per_sec": round(len(losses) / elapsed, 2),
             "first_segment_reward": seg_rewards[0] if seg_rewards else None,

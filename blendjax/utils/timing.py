@@ -40,6 +40,56 @@ FEED_STAGES = (
     "recv", "collate", "arena_wait", "scatter", "recycle", "device_put",
 )
 
+#: Canonical fault/health event names (see docs/fault_tolerance.md).
+#: ``EventCounters`` accepts any name; this tuple is the shared vocabulary
+#: the fault layer increments and ``FleetSupervisor.health()`` reports —
+#: every name is present (zero) in a health snapshot even before its first
+#: event, so dashboards and tests need no existence checks.
+FLEET_EVENTS = (
+    "deaths", "restarts", "retries", "timeouts", "failures", "quarantines",
+    "readmissions", "circuit_opens", "circuit_rejections",
+    "stream_timeouts", "stream_ring_vanished", "transfer_gate_backstops",
+)
+
+
+class EventCounters:
+    """Thread-safe named event counters — the numeric half of fleet
+    observability (stage *times* live in :class:`StageTimer`; discrete
+    *events* — retries, deaths, quarantines — live here).
+
+    A process-wide default instance (:data:`fleet_counters`) is shared by
+    the fault layer so counters aggregate across components without
+    plumbing; pass a fresh instance for isolated accounting (tests,
+    per-fleet supervisors).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = defaultdict(int)
+
+    def incr(self, name, n=1):
+        with self._lock:
+            self._counts[name] += n
+
+    def get(self, name):
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self):
+        """Copy of all counters as a plain dict."""
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+
+
+#: Process-wide default counter registry (fault layer, TransferGate
+#: backstop, stream timeouts).  Component constructors take a
+#: ``counters=`` override for isolated accounting.
+fleet_counters = EventCounters()
+
 
 class StageTimer:
     """Accumulates wall-clock time per named stage (thread-safe: stages are

@@ -1,0 +1,567 @@
+"""Chaos-harness tests: deterministic fault injection end to end.
+
+Every scenario here is event-driven — faults are injected at exact,
+controllable points (proxy stall/schedule, SIGKILL) and recovery is
+awaited through bounded condition waits (``FleetSupervisor.await_*``,
+step-loop deadlines), never asserted after a bare ``time.sleep``.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.btt.chaos import ChaosProxy, kill_instance, wait_env_ready
+from blendjax.btt.envpool import EnvPool
+from blendjax.btt.faults import FaultPolicy
+from blendjax.btt.launcher import BlenderLauncher
+from blendjax.btt.supervise import FleetSupervisor
+from blendjax.utils.timing import EventCounters
+from helpers import BLEND_SCRIPTS, FAKE_BLENDER
+
+ENV_SCRIPT = f"{BLEND_SCRIPTS}/env.blend.py"
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def fake_blender(monkeypatch):
+    monkeypatch.setenv("BLENDJAX_BLENDER", FAKE_BLENDER)
+
+
+# -- wire-level proxy ---------------------------------------------------------
+
+
+class _EchoServer:
+    """Plain-TCP echo upstream: what goes in comes back, byte for byte."""
+
+    def __init__(self):
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    return
+                conn.sendall(data)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        self._sock.close()
+
+
+@pytest.fixture
+def echo():
+    srv = _EchoServer()
+    yield srv
+    srv.close()
+
+
+def _connect(proxy, timeout=5.0):
+    c = socket.create_connection((proxy.host, proxy.port), timeout=timeout)
+    c.settimeout(timeout)
+    return c
+
+
+def test_proxy_forwards_and_stalls(echo):
+    with ChaosProxy(echo.port) as proxy:
+        c = _connect(proxy)
+        try:
+            c.sendall(b"ping")
+            assert c.recv(64) == b"ping"
+            assert proxy.forwarded_bytes["up"] == 4
+            assert proxy.forwarded_bytes["down"] == 4
+
+            # stall: silence (no disconnect), then resume delivers
+            proxy.stall()
+            c.sendall(b"held")
+            c.settimeout(0.3)
+            with pytest.raises(socket.timeout):
+                c.recv(64)
+            proxy.resume()
+            c.settimeout(5.0)
+            assert c.recv(64) == b"held"
+        finally:
+            c.close()
+
+
+def test_proxy_scheduled_drop_dup_garble_close(echo):
+    with ChaosProxy(echo.port, seed=123) as proxy:
+        c = _connect(proxy)
+        try:
+            # chunk 0 up: dropped — never reaches the echo server
+            proxy.drop_next(direction="up")
+            c.sendall(b"lost")
+            c.settimeout(0.3)
+            with pytest.raises(socket.timeout):
+                c.recv(64)
+            assert proxy.dropped == 1
+
+            # next chunk: duplicated — echoed back twice
+            c.settimeout(5.0)
+            proxy.dup_next(direction="up")
+            c.sendall(b"twice")
+            got = b""
+            while len(got) < 10:
+                got += c.recv(64)
+            assert got == b"twicetwice"
+            assert proxy.duplicated == 1
+
+            # garbled on the way back: same length, different bytes
+            proxy.garble_next(direction="down")
+            c.sendall(b"corrupt-me")
+            got = c.recv(64)
+            assert len(got) == 10 and got != b"corrupt-me"
+            assert proxy.garbled == 1
+
+            # kill mid-message: connection closes when the reply transits
+            proxy.close_next(direction="down")
+            c.sendall(b"doomed")
+            assert c.recv(64) == b""  # orderly close surfaced to consumer
+        finally:
+            c.close()
+
+
+def test_proxy_deterministic_schedule_replay(echo):
+    """The same traffic against the same schedule produces the same
+    outcome twice — the determinism contract."""
+    outcomes = []
+    for _ in range(2):
+        with ChaosProxy(echo.port, seed=7) as proxy:
+            proxy.at(1, "drop", direction="up")  # second message vanishes
+            c = _connect(proxy)
+            try:
+                seen = []
+                for msg in (b"aa", b"bb", b"cc"):
+                    c.sendall(msg)
+                    c.settimeout(0.3)
+                    try:
+                        seen.append(c.recv(64))
+                    except socket.timeout:
+                        seen.append(None)
+                outcomes.append((tuple(seen), proxy.dropped))
+            finally:
+                c.close()
+    assert outcomes[0] == outcomes[1] == ((b"aa", None, b"cc"), 1)
+
+
+# -- EnvPool degraded mode ----------------------------------------------------
+
+
+def _policy(**kw):
+    base = dict(
+        max_retries=1,
+        deadline_s=0.6,
+        backoff_base=0.05,
+        backoff_factor=2.0,
+        backoff_max=0.2,
+        jitter=0.25,
+        circuit_threshold=0,  # probes must keep dialing through the outage
+        seed=7,
+    )
+    base.update(kw)
+    return FaultPolicy(**base)
+
+
+def test_pool_quarantine_and_readmit_through_proxy(fake_blender):
+    """A hung producer (stalled proxy) is quarantined without failing the
+    batched step; once traffic flows again, the in-step probe re-admits
+    it through the reset resync handshake."""
+    with BlenderLauncher(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        named_sockets=["GYM"],
+        start_port=12800,
+        background=True,
+        instance_args=[["--horizon", "100000"]] * 2,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        with ChaosProxy(addrs[0], seed=1) as proxy:
+            counters = EventCounters()
+            pool = EnvPool(
+                [proxy.address, addrs[1]],
+                timeoutms=10000,
+                fault_policy=_policy(),
+                counters=counters,
+            )
+            try:
+                obs, infos = pool.reset()
+                assert pool.healthy.all()
+                obs, rew, done, infos = pool.step([1.0, 2.0])
+                np.testing.assert_allclose(obs, [1.0, 2.0])
+                assert counters.snapshot() == {}  # clean so far
+
+                proxy.stall()
+                # this step times out into env 0 (retry, then quarantine)
+                # and STILL returns a full batch — training continues N-1
+                obs, rew, done, infos = pool.step([3.0, 3.0])
+                assert list(pool.healthy) == [False, True]
+                assert infos[0]["quarantined"] and not infos[0]["healthy"]
+                assert infos[1]["healthy"]
+                assert rew[0] == 0.0 and done[0]  # episode closed once
+                assert obs[1] == 3.0  # the live env really stepped
+
+                # quarantined: skipped entirely, done fires exactly once
+                obs, rew, done, infos = pool.step([4.0, 4.0])
+                assert not done[0] and not infos[0]["healthy"]
+                assert obs[1] == 4.0
+
+                proxy.resume()
+                # step until the async probe re-admits env 0 (bounded)
+                deadline = time.monotonic() + 20
+                readmitted = False
+                while time.monotonic() < deadline:
+                    obs, rew, done, infos = pool.step([5.0, 5.0])
+                    if infos[0].get("readmitted"):
+                        readmitted = True
+                        break
+                assert readmitted, "env 0 never re-admitted after resume"
+                assert pool.healthy.all()
+                assert rew[0] == 0.0 and not done[0]  # resync = fresh reset
+                assert obs[0] == 0.0  # EchoEnv initial obs
+
+                # and it steps normally again
+                obs, rew, done, infos = pool.step([6.0, 6.0])
+                assert obs[0] == 6.0 and infos[0]["healthy"]
+
+                snap = counters.snapshot()
+                assert snap["quarantines"] == 1
+                assert snap["readmissions"] == 1
+                assert snap["retries"] >= 1
+                assert snap["timeouts"] >= 2
+            finally:
+                pool.close()
+
+
+def test_pool_strict_mode_names_failed_env_and_keeps_sibling_times(
+    fake_blender,
+):
+    """quarantine=False restores fail-whole-batch, but the error must name
+    the failing env and the surviving envs' ``env_times`` must have been
+    committed (no partial-exchange desync) — the satellite fixes."""
+    with BlenderLauncher(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        named_sockets=["GYM"],
+        start_port=12820,
+        background=True,
+        instance_args=[["--horizon", "100000"]] * 2,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        with ChaosProxy(addrs[1], seed=2) as proxy:
+            pool = EnvPool(
+                [addrs[0], proxy.address],
+                timeoutms=10000,
+                fault_policy=_policy(max_retries=0),
+                quarantine=False,
+                counters=EventCounters(),
+            )
+            try:
+                pool.reset()
+                pool.step([1.0, 1.0])
+                t0 = pool.env_times[0]
+                proxy.stall()
+                with pytest.raises(TimeoutError, match="environment 1"):
+                    pool.step([2.0, 2.0])
+                # env 0 replied before env 1 failed: its clock moved on
+                assert pool.env_times[0] == t0 + 1
+            finally:
+                pool.close()
+
+
+def test_pool_all_quarantined_raises(fake_blender):
+    with BlenderLauncher(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=1,
+        named_sockets=["GYM"],
+        start_port=12840,
+        background=True,
+        instance_args=[["--horizon", "100000"]],
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        pool = EnvPool(addrs, timeoutms=10000, fault_policy=_policy(),
+                       counters=EventCounters())
+        try:
+            pool.reset()
+            pool.quarantine_env(0, reason="test")
+            with pytest.raises(TimeoutError, match="all environments"):
+                pool.step([1.0])
+        finally:
+            pool.close()
+
+
+def test_readmission_race_still_surfaces_episode_boundary(fake_blender):
+    """When re-admission completes between two training steps (heal
+    thread faster than the train loop), the interrupted episode's
+    done=True must still surface exactly once before the resync obs —
+    the boundary is never silently swallowed."""
+    with BlenderLauncher(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=1,
+        named_sockets=["GYM"],
+        start_port=12920,
+        background=True,
+        instance_args=[["--horizon", "100000"]],
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        pool = EnvPool(addrs, timeoutms=10000, fault_policy=_policy(),
+                       counters=EventCounters())
+        try:
+            pool.reset()
+            pool.step([1.0])
+            # quarantine, then re-admit WITHOUT an intervening step (the
+            # producer is alive, so probes succeed immediately)
+            pool.quarantine_env(0, reason="test")
+            deadline = time.monotonic() + 20
+            while not pool.healthy.all() and time.monotonic() < deadline:
+                pool.probe(block_ms=50)
+            assert pool.healthy.all()
+
+            # step 1: the owed terminal close-out of the old episode
+            obs, rew, done, infos = pool.step([5.0])
+            assert done[0] and rew[0] == 0.0
+            assert infos[0]["interrupted"] and infos[0]["healthy"]
+            assert obs[0] == 1.0  # last REAL obs, not the resync obs
+
+            # step 2: the held resync obs arrives via the fresh branch
+            obs, rew, done, infos = pool.step([6.0])
+            assert infos[0].get("readmitted") and not done[0]
+            assert obs[0] == 0.0  # EchoEnv initial obs
+
+            # step 3: normal stepping resumes
+            obs, rew, done, infos = pool.step([7.0])
+            assert obs[0] == 7.0 and infos[0]["healthy"]
+        finally:
+            pool.close()
+
+
+# -- supervised restart-and-resync (the acceptance scenario) ------------------
+
+
+def test_supervisor_kill_one_of_three_heals_within_deadline(fake_blender):
+    """THE acceptance chaos test: kill 1 of 3 producers mid-training.
+    ``EnvPool.step`` keeps going (quarantine mask set, no exception); the
+    supervisor respawns the producer and re-admits its env within the
+    policy deadline; ``health()`` shows non-zero retry/quarantine/restart
+    counters here and all-zero on the clean prefix.  Every wait is a
+    bounded condition wait — no bare sleeps."""
+    with BlenderLauncher(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=3,
+        named_sockets=["GYM"],
+        start_port=12860,
+        background=True,
+        instance_args=[["--horizon", "100000"]] * 3,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        counters = EventCounters()
+        pool = EnvPool(addrs, timeoutms=10000, fault_policy=_policy(),
+                       counters=counters)
+        # watchdog interval is deliberately longer than the RPC deadline:
+        # the quarantine deterministically comes from the fault policy
+        # (timeout -> retry -> isolate), the respawn from the watchdog
+        with FleetSupervisor(
+            bl, pool=pool, interval=3.0, heal_interval=0.05,
+            counters=counters,
+        ) as sup:
+            try:
+                obs, infos = pool.reset()
+                assert len(infos) == 3 and pool.healthy.all()
+
+                # clean run: a few steps, every counter stays zero
+                for k in range(3):
+                    obs, rew, done, infos = pool.step([1.0, 2.0, 3.0])
+                h = sup.health()
+                assert h["retries"] == 0 and h["quarantines"] == 0
+                assert h["deaths"] == 0 and h["restarts"] == 0
+                assert h["readmissions"] == 0 and h["timeouts"] == 0
+                assert h["healthy_envs"] == 3
+
+                kill_instance(bl, 1)
+
+                # the next step rides through the death: quarantine mask
+                # set, synthetic transition, NO exception
+                obs, rew, done, infos = pool.step([4.0, 4.0, 4.0])
+                assert list(pool.healthy) == [True, False, True]
+                assert infos[1]["quarantined"] and not infos[1]["healthy"]
+                assert rew[1] == 0.0 and done[1]
+                assert obs[0] == 4.0 and obs[2] == 4.0  # N-1 kept training
+
+                # training continues on N-1 while the supervisor works
+                obs, rew, done, infos = pool.step([5.0, 5.0, 5.0])
+                assert not done[1]  # quarantine done fired exactly once
+                assert obs[0] == 5.0 and obs[2] == 5.0
+
+                assert sup.await_deaths(1, timeout=20)
+                # respawn + resync must land within the policy deadline
+                # budget: watchdog poll + producer boot + one full probe
+                # cycle (dial + handshake + one backoff)
+                readmit_budget = (
+                    sup.watchdog.interval
+                    + 20.0  # producer interpreter boot (CI-safe bound)
+                    + 2 * pool.policy.deadline_s
+                    + pool.policy.backoff_max
+                )
+                assert sup.await_healthy(timeout=readmit_budget), (
+                    f"env not re-admitted within {readmit_budget:.1f}s; "
+                    f"health={sup.health()}"
+                )
+
+                # the re-admitted env returns through the autoreset
+                # contract: fresh initial obs, zero reward
+                obs, rew, done, infos = pool.step([6.0, 6.0, 6.0])
+                assert infos[1]["healthy"]
+                assert infos[1].get("readmitted")
+                assert rew[1] == 0.0 and not done[1]
+                assert obs[1] == 0.0
+                # and then steps for real
+                obs, rew, done, infos = pool.step([7.0, 8.0, 9.0])
+                np.testing.assert_allclose(obs, [7.0, 8.0, 9.0])
+
+                h = sup.health()
+                assert h["deaths"] == 1
+                assert h["restarts"] == 1
+                assert h["quarantines"] == 1
+                assert h["readmissions"] == 1
+                assert h["retries"] >= 1
+                assert h["timeouts"] >= 2
+                assert h["healthy_envs"] == 3 and h["num_envs"] == 3
+                assert h["alive"] == 3
+            finally:
+                pool.close()
+
+
+def test_supervisor_shm_stream_heals_after_kill(fake_blender):
+    """Satellite: the shm generation-remap path under supervision — kill a
+    ring producer; the respawn recreates the ring under the same nonce'd
+    name and the consumer stream heals through the reader's rc -4 reopen,
+    with no gap-induced TimeoutError and the deaths/restarts visible in
+    ``health()``."""
+    from blendjax.native import ring as nring
+
+    if not nring.native_available():
+        pytest.skip("native ring not built")
+
+    from blendjax.btt.dataset import RemoteIterableDataset
+
+    with BlenderLauncher(
+        scene="",
+        script=f"{BLEND_SCRIPTS}/stream.blend.py",
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12880,
+        proto="shm",
+        background=True,
+    ) as bl:
+        counters = EventCounters()
+        with FleetSupervisor(
+            bl, pool=None, interval=0.2, counters=counters
+        ) as sup:
+            healed = threading.Event()
+            sup.add_health_check("stream", healed.is_set)
+            ds = RemoteIterableDataset(
+                bl.launch_info.addresses["DATA"], max_items=10**9,
+                timeoutms=30000,
+            )
+            it = ds.stream()
+            try:
+                first = [next(it) for _ in range(5)]
+                assert [m["frameid"] for m in first] == [0, 1, 2, 3, 4]
+
+                kill_instance(bl, 0)
+                assert sup.await_deaths(1, timeout=20)
+
+                # stream heals: old-generation leftovers may drain first,
+                # then the respawned producer restarts at frame 0 — and no
+                # TimeoutError fires in between (the reopen happens inside
+                # the dataset timeout)
+                for _ in range(5000):
+                    if next(it)["frameid"] == 0:
+                        healed.set()
+                        break
+                assert healed.is_set(), "stream never remapped to the new ring"
+                assert next(it)["frameid"] == 1
+
+                h = sup.health()
+                assert h["deaths"] == 1 and h["restarts"] == 1
+                assert h["checks"] == {"stream": True}
+            finally:
+                it.close()
+
+
+@pytest.mark.slow
+def test_soak_repeated_kill_heal_cycles(fake_blender):
+    """Soak: three consecutive kill/heal cycles on the same fleet — the
+    quarantine/respawn/resync machinery must be re-entrant, with counters
+    accumulating exactly one event set per cycle."""
+    with BlenderLauncher(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        named_sockets=["GYM"],
+        start_port=12900,
+        background=True,
+        instance_args=[["--horizon", "100000"]] * 2,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        counters = EventCounters()
+        pool = EnvPool(addrs, timeoutms=10000, fault_policy=_policy(),
+                       counters=counters)
+        with FleetSupervisor(
+            bl, pool=pool, interval=1.0, heal_interval=0.05,
+            counters=counters,
+        ) as sup:
+            try:
+                pool.reset()
+                for cycle in range(1, 4):
+                    victim = cycle % 2
+                    kill_instance(bl, victim)
+                    assert sup.await_deaths(cycle, timeout=30)
+                    # keep training through the outage
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        pool.step([1.0, 1.0])
+                        if pool.healthy.all():
+                            break
+                    assert pool.healthy.all(), (
+                        f"cycle {cycle}: fleet never healed; "
+                        f"health={sup.health()}"
+                    )
+                h = sup.health()
+                assert h["deaths"] == 3 and h["restarts"] == 3
+                assert h["readmissions"] == 3
+            finally:
+                pool.close()

@@ -1,0 +1,169 @@
+"""FaultPolicy unit tests: deterministic backoff, retry/deadline/circuit
+semantics, and the EventCounters observability surface — all pure-host,
+no sockets (the wire-level paths are covered by tests/test_chaos.py)."""
+
+import threading
+
+import pytest
+
+from blendjax.btt.faults import CircuitOpenError, FaultPolicy
+from blendjax.utils.timing import FLEET_EVENTS, EventCounters
+
+
+def test_backoff_deterministic_and_capped():
+    policy = FaultPolicy(backoff_base=0.1, backoff_factor=2.0, backoff_max=0.5,
+                         jitter=0.25, seed=42)
+    a = policy.new_state(key=3)
+    b = policy.new_state(key=3)
+    seq_a = [a.backoff(n) for n in range(1, 8)]
+    seq_b = [b.backoff(n) for n in range(1, 8)]
+    assert seq_a == seq_b  # same (seed, key) -> identical jitter stream
+    other = policy.new_state(key=4)
+    assert [other.backoff(n) for n in range(1, 8)] != seq_a
+    # exponential under the cap, jitter-bounded throughout
+    for n, d in enumerate(seq_a, start=1):
+        base = min(0.5, 0.1 * 2.0 ** (n - 1))
+        assert base * 0.75 <= d <= base * 1.25
+
+
+def test_no_jitter_is_exact():
+    policy = FaultPolicy(backoff_base=0.1, backoff_factor=2.0, backoff_max=1.0,
+                         jitter=0.0)
+    st = policy.new_state()
+    assert [st.backoff(n) for n in (1, 2, 3, 4, 5)] == pytest.approx(
+        [0.1, 0.2, 0.4, 0.8, 1.0]
+    )
+
+
+def test_run_retries_then_succeeds():
+    counters = EventCounters()
+    policy = FaultPolicy(max_retries=3, backoff_base=0.01, jitter=0.0)
+    calls = []
+    slept = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise TimeoutError("transient")
+        return "ok"
+
+    assert policy.run(fn, counters=counters, sleep=slept.append) == "ok"
+    assert calls == [0, 1, 2]
+    assert counters.get("retries") == 2
+    assert counters.get("timeouts") == 2
+    assert counters.get("failures") == 0
+    assert slept == pytest.approx([0.01, 0.02])
+
+
+def test_run_exhausts_and_raises():
+    counters = EventCounters()
+    policy = FaultPolicy(max_retries=2, backoff_base=0.001, jitter=0.0)
+
+    def fn(attempt):
+        raise TimeoutError("down")
+
+    with pytest.raises(TimeoutError, match="down"):
+        policy.run(fn, counters=counters, sleep=lambda s: None)
+    assert counters.get("retries") == 2
+    assert counters.get("failures") == 1
+    assert counters.get("timeouts") == 3
+
+
+def test_run_non_retryable_propagates_immediately():
+    policy = FaultPolicy(max_retries=5)
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        raise ValueError("logic bug, not a fault")
+
+    with pytest.raises(ValueError):
+        policy.run(fn, sleep=lambda s: None, counters=EventCounters())
+    assert calls == [0]
+
+
+def test_deadline_stops_retrying():
+    # fake clock: every read advances 1.0s, so the post-failure budget
+    # check lands exactly on the deadline after the first attempt and
+    # only one attempt runs despite max_retries=10
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    counters = EventCounters()
+    policy = FaultPolicy(max_retries=10, deadline_s=1.0, backoff_base=0.01,
+                         jitter=0.0, _clock=clock)
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        raise TimeoutError("slow")
+
+    with pytest.raises(TimeoutError):
+        policy.run(fn, counters=counters, sleep=lambda s: None)
+    assert len(calls) == 1
+    assert counters.get("failures") == 1
+
+
+def test_circuit_opens_and_cools_down():
+    t = [0.0]
+    policy = FaultPolicy(
+        max_retries=0, circuit_threshold=3, circuit_cooldown_s=10.0,
+        backoff_base=0.0, jitter=0.0, _clock=lambda: t[0],
+    )
+    counters = EventCounters()
+    state = policy.new_state()
+
+    def fn(attempt):
+        raise TimeoutError("dead")
+
+    # three consecutive failures trip the breaker
+    for _ in range(3):
+        with pytest.raises(TimeoutError):
+            policy.run(fn, state=state, counters=counters,
+                       sleep=lambda s: None)
+    assert counters.get("circuit_opens") == 1
+    assert state.circuit_open()
+
+    # while open: rejected without calling fn
+    calls = []
+    with pytest.raises(CircuitOpenError):
+        policy.run(lambda a: calls.append(a), state=state, counters=counters)
+    assert calls == []
+    assert counters.get("circuit_rejections") == 1
+
+    # after the cooldown: half-open, one trial allowed; success closes it
+    t[0] = 11.0
+    assert policy.run(lambda a: "back", state=state, counters=counters) == "back"
+    assert not state.circuit_open()
+    assert state.consecutive_failures == 0
+
+
+def test_event_counters_thread_safe_and_snapshot():
+    c = EventCounters()
+    threads = [
+        threading.Thread(target=lambda: [c.incr("x") for _ in range(1000)])
+        for _ in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert c.get("x") == 8000
+    c.incr("y", 5)
+    snap = c.snapshot()
+    assert snap == {"x": 8000, "y": 5}
+    snap["x"] = 0  # snapshot is a copy
+    assert c.get("x") == 8000
+    c.reset()
+    assert c.snapshot() == {}
+    assert c.get("missing") == 0
+
+
+def test_fleet_events_vocabulary_is_reported_zero_filled():
+    """health() zero-fills from FLEET_EVENTS; lock the core names."""
+    for name in ("deaths", "restarts", "retries", "timeouts", "quarantines",
+                 "readmissions", "circuit_opens", "transfer_gate_backstops"):
+        assert name in FLEET_EVENTS

@@ -57,6 +57,43 @@ def test_detects_death_once(fake_blender):
             assert wd.alive == 0
 
 
+def test_on_death_exception_does_not_kill_watchdog(fake_blender):
+    """An exception in user callback code must not silently kill the
+    watchdog thread — it is exactly the component that must not die.  The
+    producer exits after each (re)spawn, so surviving the first callback
+    blast means more deaths keep being detected and restarted."""
+    deaths = []
+
+    def bad_callback(idx, code):
+        deaths.append((idx, code))
+        raise RuntimeError("user callback bug")
+
+    with BlenderLauncher(
+        scene="",
+        script=f"{BLEND_SCRIPTS}/exit.blend.py",
+        num_instances=1,
+        named_sockets=["DATA"],
+        start_port=12660,
+        background=True,
+    ) as bl:
+        with FleetWatchdog(
+            bl, interval=0.2, on_death=bad_callback, restart=True
+        ) as wd:
+            # each (re)spawned producer publishes once and exits, but only
+            # once a consumer drains it (PUSH blocks peerless) — so drain
+            # per generation and await its death report
+            for expected in (1, 2):
+                _drain(bl.launch_info.addresses["DATA"], 1)
+                deadline = time.time() + 30
+                while len(deaths) < expected and time.time() < deadline:
+                    time.sleep(0.1)
+                # a report after the previous callback raised proves the
+                # thread survived; restarts kept happening too
+                assert len(deaths) >= expected
+            assert wd._thread.is_alive()
+            assert all(d[2] for d in wd.deaths)
+
+
 def test_restart_respawns_instance(fake_blender):
     with BlenderLauncher(
         scene="",
