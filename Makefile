@@ -9,7 +9,7 @@ PYTHON ?= python
 # tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos blender-tests tpu-tests bench dryrun
+.PHONY: test tier1 chaos blender-tests tpu-tests bench rlbench dryrun
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -63,6 +63,16 @@ tpu-tests:
 
 bench:
 	$(PYTHON) bench.py
+
+# Jax-free RL stepping microbench: lock-step vs async pipelined EnvPool
+# (fake-Blender fleet speaking the real wire protocol, 250 us/frame
+# physics stand-in).  One JSON line with rl_pipelined_x — the
+# serialization tax recovered by step_async/step_wait.  See
+# docs/rl_stepping.md.
+rlbench:
+	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/rl_benchmark.py \
+		--instances 4 --seconds 15 --physics-us 250 \
+		--compare --pipeline-depth 4
 
 dryrun:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

@@ -287,9 +287,31 @@ def main():
             min(45, remaining),
         )
         rl_physics = rl_lines[-1] if rl_lines else None
+    # third configuration: the async pipelined path at the same 250 us
+    # physics cost — the with-physics serialization tax is exactly what
+    # step_async/step_wait hides.  --compare interleaves lock-step and
+    # pipelined windows on ONE fleet and reports the median paired ratio
+    # (rl_pipelined_x), which survives the 2x throughput drift of shared
+    # CI hosts that back-to-back whole runs do not
+    rl_pipelined = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if rl_physics and remaining > 45:
+        rl_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "rl_benchmark.py"),
+                "--instances", str(instances),
+                "--seconds", "15",
+                "--physics-us", "250",
+                "--compare", "--pipeline-depth", "4",
+            ],
+            rl_env,
+            min(75, remaining),
+        )
+        rl_pipelined = rl_lines[-1] if rl_lines else None
 
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
-                   feed_bound=feed_bound)
+                   feed_bound=feed_bound, rl_pipelined=rl_pipelined)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -333,6 +355,7 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("feed_arena_x",),
+    ("rl_pipelined_x",),
     ("attn",),
     ("wire_limit", "wire_eff", "wire_eff_ok"),
     ("duty", "duty_cycle_invalid", "seq_duty", "seq_duty_invalid"),
@@ -352,6 +375,9 @@ def headline(out):
     if fb and fb.get("arena_over_legacy") is not None:
         # arena assembly speedup over legacy collate at the feed ceiling
         line["feed_arena_x"] = fb["arena_over_legacy"]
+    if out.get("rl_pipelined_x") is not None:
+        # async pipelined EnvPool speedup over lock-step at physics 250us
+        line["rl_pipelined_x"] = out["rl_pipelined_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -403,7 +429,7 @@ def headline(out):
 
 
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
-             feed_bound=None):
+             feed_bound=None, rl_pipelined=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -622,6 +648,26 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
     if rl_physics:
         extras["rl_steps_per_sec_physics250us"] = rl_physics.get("value")
         extras["rl_vs_baseline_physics250us"] = rl_physics.get("vs_baseline")
+    if rl_pipelined:
+        extras["rl_pipeline_depth"] = rl_pipelined.get("pipeline_depth")
+        if rl_pipelined.get("metric") == "rl_pipelined_x":
+            # --compare line: the ratio IS the value (median of
+            # interleaved lock-step/pipelined window pairs on one fleet —
+            # the serialization tax the async path recovered), with both
+            # absolute medians alongside
+            extras["rl_pipelined_x"] = rl_pipelined.get("value")
+            extras["rl_steps_per_sec_pipelined"] = rl_pipelined.get(
+                "pipelined_steps_per_sec"
+            )
+        else:
+            # single-mode pipelined line: ratio against the lock-step
+            # phase (two separate runs; drift-prone, kept for compat)
+            extras["rl_steps_per_sec_pipelined"] = rl_pipelined.get("value")
+            base = (rl_physics or {}).get("value")
+            if rl_pipelined.get("value") and base:
+                extras["rl_pipelined_x"] = round(
+                    rl_pipelined["value"] / base, 3
+                )
 
     def dims(p):
         # cpu-fallback phases may run shrunken frames, and the wire

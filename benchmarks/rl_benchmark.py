@@ -12,6 +12,15 @@ the reference's ~2000 Hz is dominated by the RPC round trip (its physics
 cartpole sim costs ~nothing per frame), so the fake-Blender fleet speaks
 the identical protocol through the identical stack.
 
+``--pipeline-depth K`` switches the consumer loop to the async
+``step_async``/``step_wait`` path (K requests in flight per env over
+DEALER sockets — see docs/rl_stepping.md): producers integrate the next
+frame while the consumer is still handling the previous replies, so the
+per-step serialization tax (fan-out RTT + slowest physics, every step)
+collapses to max(physics, consumer work).  ``--compare`` runs lock-step
+then pipelined in one process and reports the ratio as
+``rl_pipelined_x`` — the jax-free microbench behind ``make rlbench``.
+
 Run: ``python benchmarks/rl_benchmark.py [--instances 4] [--seconds 10]``
 Prints one JSON line: aggregate env-steps/sec and vs_baseline vs 2000 Hz.
 """
@@ -31,7 +40,7 @@ if os.path.dirname(HERE) not in sys.path:
 REFERENCE_HZ = 2000.0  # Readme.md:95, physics-only stepping
 
 
-def launch_pool_for(args):
+def launch_pool_for(args, pipeline_depth=1, port_salt=0):
     """One copy of the fleet setup for both configurations: fake-Blender
     fallback, env fixture script, and a randomized port base so
     back-to-back benchmark children can't collide on the launcher's
@@ -55,7 +64,8 @@ def launch_pool_for(args):
         timeoutms=30000,
         horizon=1_000_000_000,  # episodes never end inside the window
         physics_us=args.physics_us,
-        start_port=20000 + (os.getpid() * 37) % 20000,
+        start_port=20000 + (os.getpid() * 37 + port_salt * 131) % 20000,
+        pipeline_depth=pipeline_depth,
     )
 
 
@@ -85,6 +95,114 @@ def run(args):
         # busy-wait standing in for a solver tick
         "includes_physics": args.physics_us > 0,
         "physics_us": args.physics_us,
+    }
+
+
+def run_pipelined(args, port_salt=1):
+    """Async pipelined configuration: ``--pipeline-depth`` requests in
+    flight per env, collected ready-first (``min_ready=1``) and
+    immediately resubmitted to exactly the envs that completed, so every
+    producer's request queue stays non-empty and physics overlaps the
+    consumer's reply handling — no barrier re-serializes on the
+    straggler."""
+    depth = args.pipeline_depth
+    with launch_pool_for(args, pipeline_depth=depth,
+                         port_salt=port_salt) as pool:
+        pool.reset()
+        n_envs = args.instances
+        for _ in range(depth):
+            pool.step_async([0.5] * n_envs)
+        # warmup: first exchanges absorb connect + frame-loop spin-up
+        warmed = 0
+        while warmed < 32 * n_envs:
+            idx, *_ = pool.step_wait(min_ready=1)
+            pool.step_async([0.5] * len(idx), indices=list(idx))
+            warmed += len(idx)
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < args.seconds:
+            idx, *_ = pool.step_wait(min_ready=1)
+            pool.step_async([0.5] * len(idx), indices=list(idx))
+            n += len(idx)
+        dt = time.perf_counter() - t0
+        pool.step_wait()  # drain the tail before teardown
+    steps_per_sec = n / dt
+    return {
+        "metric": "rl_steps_per_sec_pipelined",
+        "value": round(steps_per_sec, 1),
+        "unit": "env-steps/sec",
+        "instances": args.instances,
+        "pipeline_depth": depth,
+        "per_env_hz": round(steps_per_sec / args.instances, 1),
+        "vs_baseline": round(steps_per_sec / REFERENCE_HZ, 3),
+        "includes_physics": args.physics_us > 0,
+        "physics_us": args.physics_us,
+    }
+
+
+def run_compare(args, pairs=5):
+    """Lock-step vs pipelined on the SAME fleet, alternating measurement
+    windows; one JSON line with the median paired ratio
+    (``rl_pipelined_x``) — the acceptance microbench.
+
+    Interleaving matters: shared/throttled CI boxes drift in absolute
+    throughput by 2x within a minute, so back-to-back whole runs compare
+    different machines.  Adjacent windows see the same conditions and
+    their ratio cancels the drift; the median over ``pairs`` discards a
+    window that caught a scheduling hiccup."""
+    depth = args.pipeline_depth
+    n_envs = args.instances
+    # windows must dwarf the multi-second scheduler stalls seen on shared
+    # CI hosts, or a single stall dominates one side of a pair
+    window_s = max(args.seconds / pairs, 3.0)
+
+    def lock_window(pool):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < window_s:
+            pool.step([0.5] * n_envs)
+            n += n_envs
+        return n / (time.perf_counter() - t0)
+
+    def pipe_window(pool):
+        for _ in range(depth):
+            pool.step_async([0.5] * n_envs)
+        warmed = 0
+        while warmed < 16 * n_envs:  # refill the producers' queues
+            idx, *_ = pool.step_wait(min_ready=1)
+            pool.step_async([0.5] * len(idx), indices=list(idx))
+            warmed += len(idx)
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < window_s:
+            idx, *_ = pool.step_wait(min_ready=1)
+            pool.step_async([0.5] * len(idx), indices=list(idx))
+            n += len(idx)
+        rate = n / (time.perf_counter() - t0)
+        pool.step_wait()  # drain before handing the fleet back
+        return rate
+
+    locks, pipes, ratios = [], [], []
+    with launch_pool_for(args, pipeline_depth=depth) as pool:
+        pool.reset()
+        for _ in range(32):  # warmup: connect + frame-loop spin-up
+            pool.step([0.5] * n_envs)
+        for _ in range(pairs):
+            locks.append(lock_window(pool))
+            pipes.append(pipe_window(pool))
+            ratios.append(pipes[-1] / max(locks[-1], 1e-9))
+    med = sorted(ratios)[len(ratios) // 2]
+    return {
+        "metric": "rl_pipelined_x",
+        "value": round(med, 3),
+        "unit": "x (pipelined / lock-step env-steps/sec, median of "
+                f"{pairs} interleaved pairs)",
+        "instances": args.instances,
+        "pipeline_depth": depth,
+        "physics_us": args.physics_us,
+        "lockstep_steps_per_sec": round(sorted(locks)[len(locks) // 2], 1),
+        "pipelined_steps_per_sec": round(sorted(pipes)[len(pipes) // 2], 1),
+        "pair_ratios": [round(r, 3) for r in ratios],
     }
 
 
@@ -126,10 +244,26 @@ def main(argv=None):
         "--physics-us", type=int, default=0,
         help="busy-wait per env step, simulating physics solver cost",
     )
+    ap.add_argument(
+        "--pipeline-depth", type=int, default=0,
+        help="async step_async/step_wait mode with this many requests "
+             "in flight per env (0 = lock-step step())",
+    )
+    ap.add_argument(
+        "--compare", action="store_true",
+        help="run lock-step AND pipelined, report rl_pipelined_x "
+             "(requires --pipeline-depth >= 1)",
+    )
     ap.add_argument("--podracer", action="store_true",
                     help="overlapped actor/learner configuration")
     args = ap.parse_args(argv)
-    if args.podracer:
+    if args.compare:
+        if args.pipeline_depth < 1:
+            args.pipeline_depth = 4
+        print(json.dumps(run_compare(args)))
+    elif args.pipeline_depth >= 1:
+        print(json.dumps(run_pipelined(args)))
+    elif args.podracer:
         # jax runs in this child: keep it off a possibly-slow accelerator
         # tunnel — the policy is tiny and the subject is the RL stack
         import jax

@@ -16,11 +16,23 @@ REP socket: one remote ``step()`` == one simulated frame.  With
 ``real_time=True`` the socket goes non-blocking and simulation time
 advances even when the agent is slow (missed frames step with no action).
 
+Requests stamped with a correlation id (``wire.BTMID_KEY`` — the
+pipelined :class:`blendjax.btt.envpool.EnvPool` and any fault-policy
+retry path do this) get the id echoed in the reply, and a re-sent
+request carrying the id of a step already served is answered from the
+``wire.REPLY_CACHE_DEPTH``-deep reply cache instead of simulating the
+frame twice — the
+consumer-side retry of a non-idempotent ``step`` becomes exactly-once
+(see the caveat in :mod:`blendjax.btt.faults`).  Unstamped requests
+(reference consumers) behave exactly as before.
+
 Module import needs no bpy; only instantiating ``BaseEnv`` touches the
 animation system, so the RPC state machine is unit-testable in CI.
 """
 
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import zmq
 
@@ -155,6 +167,15 @@ class RemoteControlledAgent:
     STATE_REQ = "await_request"
     STATE_REP = "send_reply"
 
+    #: replies kept for duplicate suppression — must cover the consumer's
+    #: whole in-flight window (its pipeline depth), since a retry can
+    #: target any outstanding request, not just the newest.  Shared with
+    #: the consumer via ``wire`` so ``EnvPool`` can refuse a
+    #: ``pipeline_depth`` that outruns the window.  Kept small: cached
+    #: replies hold full payloads (rgb_array included), so this bounds
+    #: producer-side memory at depth * frame size
+    REPLY_CACHE_DEPTH = wire.REPLY_CACHE_DEPTH
+
     def __init__(self, address, real_time=False, timeoutms=DEFAULT_TIMEOUTMS):
         self._ctx = zmq.Context.instance()
         self.socket = self._ctx.socket(zmq.REP)
@@ -164,30 +185,84 @@ class RemoteControlledAgent:
         self.socket.bind(address)
         self.real_time = real_time
         self.state = RemoteControlledAgent.STATE_REQ
+        # correlation-id bookkeeping: _pending_mid rides the request being
+        # simulated; once its reply goes out it joins _reply_cache
+        # (mid -> reply) for duplicate suppression.  A pipelined consumer
+        # (EnvPool pipeline_depth > 1) may retry ANY of its in-flight
+        # requests — its oldest expired first — so the cache must cover
+        # the whole window, not just the newest reply; REPLY_CACHE_DEPTH
+        # comfortably exceeds any sane pipeline depth.
+        self._pending_mid = None
+        self._reply_cache = OrderedDict()
+        self._dup_reply = None  # cached reply owed after a NOBLOCK Again
 
     def __call__(self, env, **ctx):
         flags = 0
         if self.real_time and env.state == BaseEnv.STATE_RUN:
             flags = zmq.NOBLOCK
 
-        if self.state == RemoteControlledAgent.STATE_REP:
+        if self._dup_reply is not None:
+            # a duplicate request consumed last frame is still owed its
+            # cached reply (REP alternation): flush before anything else
             try:
-                wire.send_message(self.socket, ctx, flags=flags)
+                wire.send_message(self.socket, self._dup_reply, flags=flags)
+                self._dup_reply = None
+            except zmq.Again:
+                if not self.real_time:
+                    raise TimeoutError(
+                        "Failed to re-send cached reply to remote agent."
+                    ) from None
+                return BaseEnv.CMD_STEP, None
+
+        if self.state == RemoteControlledAgent.STATE_REP:
+            reply = ctx
+            if self._pending_mid is not None:
+                reply = {**ctx, wire.BTMID_KEY: self._pending_mid}
+            try:
+                wire.send_message(self.socket, reply, flags=flags)
                 self.state = RemoteControlledAgent.STATE_REQ
+                if self._pending_mid is not None:
+                    self._reply_cache[self._pending_mid] = reply
+                    while len(self._reply_cache) > self.REPLY_CACHE_DEPTH:
+                        self._reply_cache.popitem(last=False)
+                    self._pending_mid = None
             except zmq.Again:
                 if not self.real_time:
                     raise TimeoutError("Failed to send reply to remote agent.")
                 return BaseEnv.CMD_STEP, None
 
-        try:
-            request = self.socket.recv(flags=flags)
-        except zmq.Again:
-            return BaseEnv.CMD_STEP, None
-        request = wire.loads(request)
+        while True:
+            try:
+                request = self.socket.recv(flags=flags)
+            except zmq.Again:
+                return BaseEnv.CMD_STEP, None
+            request = wire.loads(request)
+            mid = request.get(wire.BTMID_KEY)
+            if mid is not None and mid in self._reply_cache:
+                # consumer retry of a step already simulated: serve the
+                # cached reply (exactly-once) and await the real next
+                # request.  The send is safe mid-cycle — REP queues to
+                # (or discards for) the requesting peer; under real_time
+                # a full pipe stashes the owed reply for the next frame
+                # instead of raising inside Blender's frame callback.
+                try:
+                    wire.send_message(
+                        self.socket, self._reply_cache[mid], flags=flags
+                    )
+                except zmq.Again:
+                    if not self.real_time:
+                        raise TimeoutError(
+                            "Failed to re-send cached reply to remote agent."
+                        ) from None
+                    self._dup_reply = self._reply_cache[mid]
+                    return BaseEnv.CMD_STEP, None
+                continue
+            break
         cmd_name = request.get("cmd")
         if cmd_name not in ("reset", "step"):
             raise ValueError(f"unknown remote command {cmd_name!r}")
         self.state = RemoteControlledAgent.STATE_REP
+        self._pending_mid = mid
 
         if cmd_name == "reset":
             if env.state == BaseEnv.STATE_INIT:

@@ -28,8 +28,9 @@ class RemoteEnv:
     every RPC retry with backoff inside the policy's deadline and trips a
     circuit breaker after consecutive failures; without one, a single
     timeout raises (the reference behavior).  Retries re-send the request
-    — see :mod:`blendjax.btt.faults` for the non-idempotency caveat on
-    ``step``.
+    under the same correlation id, which blendjax producers dedupe (the
+    frame is never simulated twice) — see :mod:`blendjax.btt.faults` for
+    the caveat with producers that ignore the id.
     """
 
     def __init__(self, address, timeoutms=DEFAULT_TIMEOUTMS, fault_policy=None,
@@ -85,6 +86,10 @@ class RemoteEnv:
     def _reqrep(self, **send_kwargs):
         if self.fault_policy is None:
             return self._attempt(send_kwargs)
+        # one correlation id for every re-send of this logical call: the
+        # producer-side agent dedupes a retried non-idempotent ``step``
+        # (serving its cached reply instead of simulating the frame twice)
+        wire.stamp_message_id(send_kwargs)
         return self.fault_policy.run(
             lambda attempt: self._attempt(send_kwargs),
             state=self._fault_state,
@@ -103,6 +108,7 @@ class RemoteEnv:
             ddict = wire.recv_message(self.socket)
         except zmq.Again:
             raise TimeoutError("No response from remote environment") from None
+        ddict.pop(wire.BTMID_KEY, None)  # echoed correlation id, not info
         self.env_time = ddict["time"]
         return ddict
 

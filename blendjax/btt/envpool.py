@@ -27,6 +27,22 @@ Quarantined envs are probed in the background of each ``step`` (or by a
 ``reset`` resync handshake; on success the env re-enters the pool through
 the standard autoreset contract (fresh initial obs, zero reward).  Only
 when *every* env is quarantined does ``step`` raise.
+
+Async pipelined stepping (see docs/rl_stepping.md): ``step()`` is
+lock-step — every call pays a full fan-out round trip plus the slowest
+env's physics before any learner compute runs.  The
+``step_async(actions)`` / ``step_wait(min_ready=k)`` pair overlaps the
+two instead: requests ride DEALER sockets (empty-delimiter framing, so
+the producers' REP sockets serve them unmodified) with per-request
+correlation ids (``wire.BTMID_KEY``), up to ``pipeline_depth`` requests
+in flight per env, and ``step_wait`` returns the first ``k`` completed
+transitions *with their env indices* instead of blocking on stragglers.
+The fault machinery covers the pipeline: in-flight requests age against
+the policy deadline (retry -> re-send same correlation id, which the
+producer agent dedupes -> quarantine), a quarantine mid-flight converts
+that env's outstanding requests into synthetic transitions (the first
+carrying the episode-closing ``done=True``) without touching survivors,
+and re-admission resyncs the pipeline depth from zero.
 """
 
 from __future__ import annotations
@@ -34,6 +50,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 
 import numpy as np
@@ -66,6 +83,24 @@ def _zero_like(obs):
     return obs
 
 
+def _empty_batch_like(obs):
+    """Zero-row batch matching ``collate``'s layout for samples shaped
+    like ``obs``, so a timeout-expiry ``step_wait`` return concatenates
+    cleanly with non-empty batches."""
+    if isinstance(obs, np.ndarray):
+        return np.empty((0,) + obs.shape, obs.dtype)
+    if isinstance(obs, dict):
+        return {k: _empty_batch_like(v) for k, v in obs.items()}
+    if isinstance(obs, (list, tuple)):
+        seq = [_empty_batch_like(v) for v in obs]
+        return seq if isinstance(obs, list) else tuple(seq)
+    if isinstance(obs, bool):
+        return np.empty((0,), bool)
+    if isinstance(obs, (int, float, complex, np.number)):
+        return np.empty((0,), np.asarray(obs).dtype)
+    return []
+
+
 class EnvPool:
     """Batched client for N remote Blender environments.
 
@@ -94,6 +129,10 @@ class EnvPool:
     counters: EventCounters | None
         Fault-event sink; defaults to the process-wide
         ``blendjax.utils.timing.fleet_counters``.
+    pipeline_depth: int
+        Maximum requests in flight per env on the async
+        ``step_async``/``step_wait`` path (>= 1).  Lock-step ``step()``
+        ignores it.
     """
 
     def __init__(
@@ -104,7 +143,19 @@ class EnvPool:
         fault_policy=None,
         quarantine=True,
         counters=None,
+        pipeline_depth=1,
     ):
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        if pipeline_depth > wire.REPLY_CACHE_DEPTH:
+            # beyond the producer's dedupe window, a retried oldest
+            # in-flight request can no longer be answered from its reply
+            # cache — the frame would silently be simulated twice
+            raise ValueError(
+                f"pipeline_depth {pipeline_depth} exceeds the producer "
+                f"reply-cache window ({wire.REPLY_CACHE_DEPTH}): retries "
+                "could double-apply a non-idempotent step"
+            )
         self._ctx = zmq.Context.instance()
         self._addresses = list(addresses)
         self._timeoutms = timeoutms
@@ -126,6 +177,22 @@ class EnvPool:
         self._fresh = [None] * self.num_envs  # unconsumed resync reset reply
         self._pending_done = set()  # envs owing their one quarantine done=True
         self._last_obs = [None] * self.num_envs
+        # async pipeline state (step_async/step_wait).  DEALER channels are
+        # dialed lazily — a pool that only ever uses lock-step step() never
+        # opens them.  _dealer_stale marks channels that must be re-dialed
+        # before reuse (set by quarantine from any thread; acted on only by
+        # the async caller's thread, which owns the sockets).
+        self.pipeline_depth = int(pipeline_depth)
+        self._dealers = [None] * self.num_envs
+        self._dealer_stale = [False] * self.num_envs
+        # None until the env's first async reply; then whether the
+        # producer echoes wire.BTMID_KEY.  Non-echoing (legacy) producers
+        # fall back to FIFO reply matching, which a retry re-send would
+        # corrupt (two mid-less replies for one record) — the aging pass
+        # escalates their timeouts to failure instead of retrying
+        self._mid_echo = [None] * self.num_envs
+        self._inflight = [deque() for _ in range(self.num_envs)]
+        self._ready = deque()  # completed transitions, completion order
 
     def _connect(self, addr):
         s = self._ctx.socket(zmq.REQ)
@@ -135,6 +202,25 @@ class EnvPool:
         s.setsockopt(zmq.REQ_RELAXED, 1)
         s.setsockopt(zmq.REQ_CORRELATE, 1)
         s.connect(addr)
+        return s
+
+    def _dealer_socket(self, i):
+        """The async channel for env ``i`` (lock held).  Re-dialed when
+        stale — a quarantine marks the channel dirty so replies belonging
+        to the pre-quarantine pipeline can never poison the re-admitted
+        env; only the async caller's thread (which owns the sockets)
+        actually closes/re-dials, keeping zmq single-threaded."""
+        s = self._dealers[i]
+        if s is None or self._dealer_stale[i]:
+            if s is not None:
+                s.close(0)
+            s = self._ctx.socket(zmq.DEALER)
+            # no SNDTIMEO/RCVTIMEO: every dealer send/recv is non-blocking
+            # (DONTWAIT + Poller), so socket timeouts would be inert
+            s.setsockopt(zmq.LINGER, 0)
+            s.connect(self._addresses[i])
+            self._dealers[i] = s
+            self._dealer_stale[i] = False
         return s
 
     # -- health surface -----------------------------------------------------
@@ -149,6 +235,12 @@ class EnvPool:
     def quarantined(self):
         with self._lock:
             return self._quarantined.copy()
+
+    @property
+    def inflight(self):
+        """Per-env count of async requests currently in flight."""
+        with self._lock:
+            return [len(dq) for dq in self._inflight]
 
     # -- pipelined RPC ------------------------------------------------------
 
@@ -194,6 +286,13 @@ class EnvPool:
 
     def _exchange_locked_out(self, requests, indices, blocked=()):
         reqs = dict(zip(indices, requests))
+        # stamp once per logical call: a policy-driven re-send below
+        # carries the SAME id, so a blendjax producer that already
+        # simulated the frame re-serves its cached reply instead of
+        # stepping twice (the id is echoed in the reply and popped on
+        # receive, so lock-step results stay bit-identical)
+        for req in reqs.values():
+            wire.stamp_message_id(req)
         replies, failed = {}, {}
         awaiting = []
         for i in indices:
@@ -250,6 +349,7 @@ class EnvPool:
                             exc_info=True,
                         )
                         continue
+                    ddict.pop(wire.BTMID_KEY, None)
                     self.env_times[i] = ddict.get("time")
                     self._states[i].record_success()
                     replies[i] = ddict
@@ -301,7 +401,14 @@ class EnvPool:
     def quarantine_env(self, i, reason="unresponsive"):
         """Isolate env ``i``: no more RPCs until a probe re-admits it.
         Idempotent; safe from any thread (the supervisor calls this
-        proactively on producer death, ahead of any timeout)."""
+        proactively on producer death, ahead of any timeout).
+
+        A quarantine mid-flight drains the env's async pipeline: every
+        outstanding request it owed a transition for becomes a synthetic
+        ready transition (the first carrying the episode's one
+        ``done=True``), and the DEALER channel is marked stale so its
+        possible late replies are orphaned rather than delivered to the
+        re-admitted incarnation."""
         with self._lock:
             if self._quarantined[i]:
                 return
@@ -311,6 +418,13 @@ class EnvPool:
             self._probe[i] = {"active": False, "sent": False, "started": 0.0,
                               "attempts": 0, "next_at": 0.0}
             self.counters.incr("quarantines")
+            owed = sum(1 for r in self._inflight[i] if not r["discard"])
+            if self._inflight[i]:
+                self.counters.incr("inflight_discards", len(self._inflight[i]))
+                self._inflight[i].clear()
+                self._dealer_stale[i] = True
+            for _ in range(owed):
+                self._ready.append(self._synthetic_ready_locked(i))
         logger.warning("env %d quarantined: %s", i, reason)
 
     def notify_respawn(self, i):
@@ -441,10 +555,22 @@ class EnvPool:
         with ``info['healthy'] = False``; they rejoin via the re-admission
         handshake, which itself performs a ``reset``.  Raises when every
         env is quarantined.
+
+        An explicit reset supersedes any async pipeline in progress: all
+        in-flight requests and uncollected ready transitions are
+        discarded and the DEALER channels marked for re-dial.
         """
         self.probe(block_ms=0)
         with self._lock:
             self._fresh = [None] * self.num_envs  # superseded by this reset
+            for i in range(self.num_envs):
+                if self._inflight[i]:
+                    self.counters.incr(
+                        "inflight_discards", len(self._inflight[i])
+                    )
+                    self._inflight[i].clear()
+                    self._dealer_stale[i] = True
+            self._ready.clear()
             live = [i for i in range(self.num_envs) if not self._quarantined[i]]
         if not live:
             raise TimeoutError("all environments are quarantined")
@@ -490,6 +616,44 @@ class EnvPool:
             infos.append(r)
         return collate(obs), infos
 
+    def _readmission_entry_locked(self, i):
+        """Arbitrate the re-admission race for env ``i`` (lock held) and
+        return its completed transition, or ``None`` when no unconsumed
+        resync reply is waiting.
+
+        When re-admission won the race with the training loop, the
+        interrupted episode's terminal transition (``done=True`` on the
+        last real obs) must still surface exactly once — it is emitted
+        NOW and the fresh resync obs stays held for the next
+        consumption.  Otherwise the resync observation surfaces through
+        the autoreset contract (``readmitted=True``, zero reward).  Both
+        lock-step ``step()`` and ``step_async`` route re-admission
+        through here so the race arbitration can never diverge between
+        the two modes.
+        """
+        if self._fresh[i] is None or self._quarantined[i]:
+            return None
+        if i in self._pending_done:
+            self._pending_done.discard(i)
+            self._needs_reset[i] = False
+            return {
+                "env": i, "obs": self._synthetic_obs(i), "reward": 0.0,
+                "done": True,
+                "info": {"healthy": True, "quarantined": True,
+                         "interrupted": True},
+            }
+        f = self._fresh[i]
+        self._fresh[i] = None
+        self._last_obs[i] = f.pop("obs")
+        f.pop("rgb_array", None)
+        f.pop(wire.BTMID_KEY, None)
+        f.update(healthy=True, readmitted=True)
+        self._needs_reset[i] = False
+        return {
+            "env": i, "obs": self._last_obs[i], "reward": 0.0,
+            "done": False, "info": f,
+        }
+
     def step(self, actions):
         """Step all instances with a length-N batch of actions.
 
@@ -504,23 +668,26 @@ class EnvPool:
         """
         if len(actions) != self.num_envs:
             raise ValueError(f"expected {self.num_envs} actions, got {len(actions)}")
+        if any(self._inflight) or self._ready:
+            # the two modes share env_times/needs_reset state and must not
+            # interleave: the producers' REP sockets fair-queue across the
+            # REQ and DEALER connections, so a lock-step request could
+            # overtake queued pipeline requests and desync the clocks
+            raise RuntimeError(
+                "lock-step step() called with async requests in flight; "
+                "drain them with step_wait() (or reset()) first"
+            )
         self.probe(block_ms=0)
         with self._lock:
             quarantined = self._quarantined.copy()
-            fresh, owe_done = {}, set()
+            # env -> completed re-admission transition (the fresh resync
+            # obs, or the interrupted episode's owed terminal), consumed
+            # ahead of the exchange exactly as the async path does
+            pre = {}
             for i in range(self.num_envs):
-                if self._fresh[i] is not None and not quarantined[i]:
-                    if i in self._pending_done:
-                        # re-admission won the race with the training
-                        # loop: the interrupted episode's terminal
-                        # transition (done=True on the last real obs) must
-                        # still surface exactly once — emit it THIS step
-                        # and hold the fresh resync obs for the next one
-                        self._pending_done.discard(i)
-                        owe_done.add(i)
-                    else:
-                        fresh[i] = self._fresh[i]
-                        self._fresh[i] = None
+                entry = self._readmission_entry_locked(i)
+                if entry is not None:
+                    pre[i] = entry
         if quarantined.all():
             raise TimeoutError("all environments are quarantined")
         if not self.quarantine and quarantined.any():
@@ -535,7 +702,7 @@ class EnvPool:
             )
         send_idx, requests = [], []
         for i, action in enumerate(actions):
-            if quarantined[i] or i in fresh or i in owe_done:
+            if quarantined[i] or i in pre:
                 continue
             send_idx.append(i)
             if self.autoreset and self._needs_reset[i]:
@@ -546,7 +713,7 @@ class EnvPool:
                 )
         replies, failed = self._exchange(requests, indices=send_idx)
         self._fail_or_quarantine(failed)
-        if not replies and not fresh and not owe_done:
+        if not replies and not pre:
             # every remaining live env failed in THIS call: raise rather
             # than hand back a batch with no real transition in it
             raise TimeoutError(
@@ -558,36 +725,31 @@ class EnvPool:
             # an env owes its one quarantine done=True only while it is
             # actually served synthetically: a reply that raced the
             # quarantine keeps its real transition, and a slot being served
-            # from `fresh`/`owe_done` this step emits its own bookkeeping —
-            # in every excluded case the pending done survives and fires on
-            # that env's next synthetic step instead of vanishing
+            # from `pre` this step emits its own bookkeeping — in every
+            # excluded case the pending done survives and fires on that
+            # env's next synthetic step instead of vanishing
             q_done = {
                 i for i in self._pending_done
                 if quarantined[i]
                 and i not in replies
-                and i not in fresh
-                and i not in owe_done
+                and i not in pre
             }
             self._pending_done -= q_done
 
         # commit every live obs BEFORE assembly so a quarantined slot can
         # synthesize a shape-matched placeholder even on the first batch
+        # (re-admission obs were committed by _readmission_entry_locked)
         for j, r in replies.items():
             self._last_obs[j] = r.pop("obs")
-        for j, f in fresh.items():
-            self._last_obs[j] = f.pop("obs")
         obs, rewards, dones, infos = [], [], [], []
         for i in range(self.num_envs):
             r = replies.get(i)
-            if i in fresh:
-                f = fresh[i]
-                f.pop("rgb_array", None)
-                f.update(healthy=True, readmitted=True)
-                obs.append(self._last_obs[i])
-                rewards.append(0.0)
-                dones.append(False)
-                self._needs_reset[i] = False
-                infos.append(f)
+            if i in pre:
+                e = pre[i]
+                obs.append(e["obs"])
+                rewards.append(e["reward"])
+                dones.append(e["done"])
+                infos.append(e["info"])
             elif r is not None:
                 was_reset = self.autoreset and self._needs_reset[i]
                 obs.append(self._last_obs[i])
@@ -598,17 +760,6 @@ class EnvPool:
                 r.pop("rgb_array", None)
                 r["healthy"] = True
                 infos.append(r)
-            elif i in owe_done:
-                # terminal close-out of the interrupted episode: last real
-                # obs, done=True; the env is healthy again and its held
-                # resync obs arrives next step via the fresh branch
-                obs.append(self._synthetic_obs(i))
-                rewards.append(0.0)
-                dones.append(True)
-                self._needs_reset[i] = False
-                infos.append(
-                    {"healthy": True, "quarantined": True, "interrupted": True}
-                )
             else:
                 obs.append(self._synthetic_obs(i))
                 rewards.append(0.0)
@@ -620,6 +771,520 @@ class EnvPool:
             np.asarray(rewards, np.float32),
             np.asarray(dones, bool),
             infos,
+        )
+
+    # -- async pipelined API ------------------------------------------------
+    #
+    # step_async/step_wait overlap env physics with learner compute: a
+    # producer with a queued request simulates its next frame while the
+    # consumer is still processing the previous reply, so the steady-state
+    # cost per transition is max(physics, consumer work) instead of
+    # RTT + physics + consumer work.  The pair is single-consumer: call it
+    # from one thread (quarantine/probe traffic from a supervisor thread
+    # remains safe, as with lock-step step()).
+
+    def step_async(self, actions, indices=None):
+        """Submit one request per env without waiting for replies.
+
+        Params
+        ------
+        actions:
+            One action per target env.  Without ``indices``, must have
+            length ``num_envs`` (one submission per env); with
+            ``indices``, ``actions[j]`` goes to env ``indices[j]`` —
+            repeating an index submits several requests to that env
+            (bounded by ``pipeline_depth`` outstanding).
+        indices: iterable[int] | None
+            Target envs; the natural argument is the index array the
+            previous ``step_wait`` returned, which keeps every env's
+            pipeline at constant depth.
+
+        Every submission eventually yields exactly one transition from
+        ``step_wait``: live envs answer with real transitions;
+        quarantined envs (and envs that fail mid-flight) yield synthetic
+        ones; a freshly re-admitted env yields its resync observation
+        through the autoreset contract.  ONE exception: requests already
+        queued behind an episode's terminal ``done`` carry post-terminal
+        frames and are consumed silently (counted in
+        ``inflight_discards`` and reported as
+        ``info['inflight_discarded']`` on the terminal transition) — a
+        constant-depth driver should resubmit that many extra actions to
+        the env to keep its pipeline full across episode boundaries.
+        With ``autoreset``, an env whose last collected transition was
+        ``done`` is sent ``reset`` instead of ``step``.  Raises
+        ``TimeoutError`` when every env is quarantined (or, strict mode,
+        when any is) and ``RuntimeError`` when an env's pipeline is
+        already at ``pipeline_depth``.
+        """
+        if indices is None:
+            if len(actions) != self.num_envs:
+                raise ValueError(
+                    f"expected {self.num_envs} actions, got {len(actions)}"
+                )
+            indices = range(self.num_envs)
+        else:
+            indices = [int(i) for i in indices]
+            if len(actions) != len(indices):
+                raise ValueError(
+                    f"expected {len(indices)} actions for {len(indices)} "
+                    f"indices, got {len(actions)}"
+                )
+        self.probe(block_ms=0)
+        wait_s = self._recv_wait_ms() / 1e3
+        failed = {}  # env -> reason (for quarantine/strict routing)
+        failed_counts = {}  # env -> failed submissions (owed synthetics)
+        with self._lock:
+            if self._quarantined.all():
+                raise TimeoutError("all environments are quarantined")
+            if not self.quarantine and self._quarantined.any():
+                raise TimeoutError(
+                    "environment(s) "
+                    f"{[int(i) for i in np.flatnonzero(self._quarantined)]} "
+                    "are quarantined (strict mode: no degraded batches)"
+                )
+            for i, action in zip(indices, actions):
+                entry = self._readmission_entry_locked(i)
+                if entry is not None:
+                    self._ready.append(entry)
+                    continue
+                if self._quarantined[i]:
+                    self._ready.append(self._synthetic_ready_locked(i))
+                    continue
+                live = sum(
+                    1 for r in self._inflight[i] if not r["discard"]
+                )
+                if live >= self.pipeline_depth:
+                    raise RuntimeError(
+                        f"environment {i} already has {live} requests in "
+                        f"flight (pipeline_depth={self.pipeline_depth})"
+                    )
+                if len(self._inflight[i]) >= wire.REPLY_CACHE_DEPTH:
+                    # discard-marked post-terminal frames still occupy
+                    # the producer's dedupe window; outrunning it would
+                    # let a retry double-simulate a frame
+                    raise RuntimeError(
+                        f"environment {i} has "
+                        f"{len(self._inflight[i])} requests outstanding, "
+                        f"the producer dedupe window "
+                        f"(wire.REPLY_CACHE_DEPTH={wire.REPLY_CACHE_DEPTH});"
+                        " collect transitions before resubmitting"
+                    )
+                if self._states[i].circuit_open():
+                    self.counters.incr("circuit_rejections")
+                    failed[i] = (
+                        f"environment {i} circuit open after "
+                        f"{self._states[i].consecutive_failures} consecutive "
+                        "failures"
+                    )
+                    failed_counts[i] = failed_counts.get(i, 0) + 1
+                    continue
+                if self.autoreset and self._needs_reset[i]:
+                    request = {"cmd": "reset", "time": self.env_times[i]}
+                    # optimistic flip: a depth>1 caller submitting again
+                    # before collecting must not queue a second reset
+                    self._needs_reset[i] = False
+                else:
+                    request = {
+                        "cmd": "step", "action": action,
+                        "time": self.env_times[i],
+                    }
+                mid = wire.stamp_message_id(request)
+                now = time.monotonic()
+                try:
+                    wire.send_message_dealer(
+                        self._dealer_socket(i), request, flags=zmq.DONTWAIT
+                    )
+                except zmq.Again:
+                    self.counters.incr("timeouts")
+                    self._states[i].record_failure(self.counters)
+                    failed[i] = f"send to environment {i} timed out"
+                    failed_counts[i] = failed_counts.get(i, 0) + 1
+                    continue
+                self._inflight[i].append({
+                    "mid": mid, "cmd": request["cmd"], "request": request,
+                    "sent_at": now, "expires_at": now + wait_s,
+                    "attempt": 0, "discard": False, "reply": None,
+                })
+        self._fail_or_quarantine(failed)  # strict mode raises here
+        if failed_counts:
+            # each failed submission still owes its transition — counted
+            # per submission, since a repeated index can fail twice; the
+            # quarantine above synthesized only previously-outstanding ones
+            with self._lock:
+                for i, n in failed_counts.items():
+                    for _ in range(n):
+                        self._ready.append(self._synthetic_ready_locked(i))
+
+    def step_wait(self, min_ready=None, timeout_ms=None):
+        """Collect completed transitions, ready-first.
+
+        Blocks until at least ``min_ready`` transitions are available
+        (default: every transition currently owed — full barrier), then
+        returns ALL completed ones as ``(indices, obs, rewards, dones,
+        infos)`` where ``indices`` maps each row to its env (an env at
+        depth > 1 may contribute several rows, oldest first; per-env
+        ordering is preserved).  ``min_ready`` is clamped to the number
+        of transitions actually owed, so a partially-submitted pool can
+        never deadlock.  ``timeout_ms`` bounds the wait: on expiry
+        whatever is ready is returned (possibly zero rows).
+
+        Failure semantics match ``step()``: an in-flight request that
+        exhausts the policy's retries quarantines its env (the owed
+        transitions arrive synthetically) or, with ``quarantine=False``,
+        raises a ``TimeoutError`` naming it — already-completed
+        transitions stay queued for the next ``step_wait``.
+        """
+        return self._assemble_ready(
+            self._step_wait_entries(min_ready, timeout_ms)
+        )
+
+    def _step_wait_entries(self, min_ready, timeout_ms):
+        """The ready-first collection loop; returns raw entry dicts."""
+        deadline = (
+            None if timeout_ms is None
+            else time.monotonic() + timeout_ms / 1e3
+        )
+        wait_s = self._recv_wait_ms() / 1e3
+        waited = False
+        while True:
+            with self._lock:
+                pending = [
+                    i for i in range(self.num_envs) if self._inflight[i]
+                ]
+                expected = len(self._ready) + sum(
+                    1 for i in pending for r in self._inflight[i]
+                    if not r["discard"]
+                )
+                target = (
+                    expected if min_ready is None
+                    else min(int(min_ready), expected)
+                )
+                # the full barrier also waits out discard-marked records
+                # (post-terminal frames, no row owed): it must leave the
+                # pool quiesced — step_wait() is lock-step step()'s
+                # documented remedy, so it cannot strand replies in flight
+                complete = (
+                    not pending if min_ready is None
+                    else len(self._ready) >= target
+                )
+                if complete:
+                    out = list(self._ready)
+                    self._ready.clear()
+                    return out
+                socks = {i: self._dealers[i] for i in pending
+                         if self._dealers[i] is not None
+                         and not self._dealer_stale[i]}
+                # stashed-reply records are complete (held only for
+                # in-order surfacing): never let their old deadlines zero
+                # the poll budget.  Non-empty: a queue head is always
+                # reply-less, else it would have surfaced.
+                next_expiry = min(
+                    r["expires_at"]
+                    for i in pending for r in self._inflight[i]
+                    if r.get("reply") is None
+                )
+            # fast path: drain replies already sitting in the zmq queues
+            # (the steady pipelined state — producers run ahead of the
+            # consumer) without paying for a Poller + poll syscall
+            if self._drain_async_replies(socks):
+                continue  # re-check the target before blocking
+            if not waited:
+                waited = True
+                self.counters.incr("ready_waits")
+            # poll outside the lock: a slow env must not starve the
+            # supervisor's probe/quarantine machinery
+            now = time.monotonic()
+            budget_s = next_expiry - now
+            if deadline is not None:
+                budget_s = min(budget_s, deadline - now)
+            # bounded park: a supervisor-thread quarantine_env() completes
+            # owed transitions straight into _ready, and nothing on the
+            # (dead) sockets would wake the poll — slice the wait so a
+            # proactive quarantine surfaces within ~50 ms, not the full
+            # recv budget
+            budget_s = min(budget_s, 0.05)
+            if socks and budget_s > 0:
+                poller = zmq.Poller()
+                for s in socks.values():
+                    poller.register(s, zmq.POLLIN)
+                if poller.poll(max(1, int(budget_s * 1000))):
+                    self._drain_async_replies(socks)
+            elif not socks:
+                # every pending env's channel is stale (quarantined
+                # mid-wait): loop back and let the bookkeeping settle
+                time.sleep(0.001)
+            # age the in-flight requests against the policy deadline
+            now = time.monotonic()
+            failed = {}
+            with self._lock:
+                for i in pending:
+                    if self._quarantined[i]:
+                        continue
+                    for rec in list(self._inflight[i]):
+                        if rec.get("reply") is not None:
+                            continue  # complete, held for in-order surfacing
+                        if rec["expires_at"] > now:
+                            continue
+                        self.counters.incr("timeouts")
+                        self._states[i].record_failure(self.counters)
+                        if (rec["attempt"] >= self.policy.max_retries
+                                or self._mid_echo[i] is False):
+                            # legacy producer (no correlation-id echo): a
+                            # re-send would be simulated as a SECOND frame
+                            # and its extra mid-less reply would shift the
+                            # FIFO fallback matching off by one for every
+                            # later transition — escalate instead of
+                            # retrying
+                            self.counters.incr("failures")
+                            failed[i] = (
+                                f"no response from environment {i} within "
+                                "timeout"
+                                + ("" if self._mid_echo[i] is not False else
+                                   " (producer echoes no correlation id: "
+                                   "retries unsafe on the pipelined path)")
+                            )
+                            break
+                        rec["attempt"] += 1
+                        self.counters.incr("retries")
+                        try:
+                            # same correlation id: a producer that already
+                            # simulated the frame re-serves its cached
+                            # reply instead of stepping twice
+                            wire.send_message_dealer(
+                                self._dealer_socket(i), rec["request"],
+                                flags=zmq.DONTWAIT,
+                            )
+                        except zmq.Again:
+                            self.counters.incr("failures")
+                            failed[i] = f"send to environment {i} timed out"
+                            break
+                        rec["expires_at"] = (
+                            now + wait_s
+                            + self._states[i].backoff(rec["attempt"])
+                        )
+            self._fail_or_quarantine(failed)  # strict mode raises here
+            if deadline is not None and time.monotonic() >= deadline:
+                with self._lock:
+                    out = list(self._ready)
+                    self._ready.clear()
+                return out
+
+    def _drain_async_replies(self, socks):
+        """NOBLOCK-receive every queued reply on ``socks``; returns the
+        number of messages consumed (0 = nothing was waiting)."""
+        drained = 0
+        failed = {}
+        for i, s in socks.items():
+            while True:
+                try:
+                    ddict = wire.recv_message_dealer(s, flags=zmq.NOBLOCK)
+                except zmq.Again:
+                    break
+                except Exception:
+                    # a garbled/unpicklable reply is an env fault: let
+                    # the deadline/retry machinery deal with the env
+                    logger.warning(
+                        "env %d: malformed reply discarded", i,
+                        exc_info=True,
+                    )
+                    continue
+                reason = self._process_async_reply(i, ddict)
+                drained += 1
+                if reason is not None:
+                    failed[i] = reason
+                    break
+        self._fail_or_quarantine(failed)  # strict mode raises here
+        return drained
+
+    def step_wait_full(self, timeout_ms=None):
+        """Barrier variant of :meth:`step_wait` shaped like ``step()``:
+        waits for every owed transition and returns ``(obs, rewards,
+        dones, infos)`` in env order, one row per env.  Requires each env
+        to owe exactly one transition (the ``step_async(actions)``
+        full-batch pattern); extra rows from a deeper pipeline stay
+        queued for the next wait, and an env owing none raises."""
+        entries = self._step_wait_entries(None, timeout_ms)
+        first = {}
+        leftover = []
+        for entry in entries:
+            if entry["env"] in first:
+                leftover.append(entry)
+            else:
+                first[entry["env"]] = entry
+        missing = [i for i in range(self.num_envs) if i not in first]
+        if missing:
+            # put everything back (original order) before failing: the
+            # collected rows may include terminal transitions an env will
+            # never re-emit
+            with self._lock:
+                for entry in reversed(entries):
+                    self._ready.appendleft(entry)
+                unsubmitted = [i for i in missing if not self._inflight[i]]
+            if unsubmitted:
+                raise RuntimeError(
+                    "step_wait_full: no transition owed by env(s) "
+                    f"{unsubmitted}; submit with step_async(actions) first"
+                )
+            # every missing env still has its request in flight: the
+            # timeout_ms deadline expired, not an unsubmitted pool
+            raise TimeoutError(
+                f"step_wait_full: timed out waiting on env(s) {missing} "
+                "(requests still in flight; collected rows requeued)"
+            )
+        if leftover:
+            # deeper-pipeline extras go back to the ready queue, order kept
+            with self._lock:
+                for entry in reversed(leftover):
+                    self._ready.appendleft(entry)
+        ordered = [first[i] for i in range(self.num_envs)]
+        return (
+            collate([e["obs"] for e in ordered]),
+            np.asarray([e["reward"] for e in ordered], np.float32),
+            np.asarray([e["done"] for e in ordered], bool),
+            [e["info"] for e in ordered],
+        )
+
+    def _process_async_reply(self, i, ddict):
+        """Match a reply to its in-flight record, then surface completed
+        records strictly in submission order.
+
+        A reply that overtakes a lost older one (drop/garble chaos ate
+        the older reply on the wire — a healthy DEALER<->REP channel is
+        FIFO, so a gap means loss) is stashed on its record, the older
+        requests are immediately re-sent under their original correlation
+        ids (the producer's reply cache answers without simulating the
+        frames twice), and everything surfaces once the head of the queue
+        is complete — per-env ordering and the one-transition-per-
+        submission invariant both hold through reply loss.
+
+        Returns ``None``, or a failure-reason string when the env must
+        be failed/quarantined by the caller (a producer revealed itself
+        as non-echoing AFTER a retry already went out — the FIFO
+        fallback can no longer attribute replies safely)."""
+        mid = ddict.pop(wire.BTMID_KEY, None)
+        with self._lock:
+            dq = self._inflight[i]
+            self._mid_echo[i] = mid is not None
+            if mid is None:
+                # legacy producer (no correlation echo): REP guarantees
+                # per-connection FIFO, so the oldest record matches —
+                # sound because the aging pass never re-sends to a
+                # KNOWN non-echoing producer (no duplicate replies to
+                # shift the matching)
+                if any(r["attempt"] > 0 and r.get("reply") is None
+                       for r in dq):
+                    # ... but a re-send DID go out while echo support was
+                    # still unknown (slow first reply): the producer may
+                    # have simulated that frame twice, and its duplicate
+                    # mid-less reply would land on the wrong record —
+                    # attribution is unrecoverable, fail the env cleanly
+                    # rather than deliver shifted transitions
+                    self.counters.incr("failures")
+                    return (
+                        f"environment {i} echoes no correlation id but "
+                        "was already retried: reply attribution "
+                        "unrecoverable"
+                    )
+                rec = dq[0] if dq else None
+            else:
+                rec = next((r for r in dq if r["mid"] == mid), None)
+            if rec is None or rec.get("reply") is not None:
+                self.counters.incr("stale_replies")
+                return None
+            rec["reply"] = ddict
+            self._states[i].record_success()
+            now = time.monotonic()
+            wait_s = self._recv_wait_ms() / 1e3
+            for r in dq:
+                if r is rec:
+                    break
+                if r.get("reply") is not None:
+                    continue
+                # older request whose reply was lost: recover it now
+                # instead of waiting out the deadline (budget permitting
+                # — past it, the aging pass escalates to failure)
+                if r["attempt"] >= self.policy.max_retries:
+                    continue
+                r["attempt"] += 1
+                self.counters.incr("retries")
+                try:
+                    wire.send_message_dealer(
+                        self._dealer_socket(i), r["request"],
+                        flags=zmq.DONTWAIT,
+                    )
+                except zmq.Again:
+                    continue  # aging pass will deal with it
+                r["expires_at"] = (
+                    now + wait_s + self._states[i].backoff(r["attempt"])
+                )
+            while dq and dq[0].get("reply") is not None:
+                r = dq.popleft()
+                reply = r["reply"]
+                self.env_times[i] = reply.get("time")
+                if r["discard"]:
+                    continue  # post-done frame: consumed, never surfaced
+                self._last_obs[i] = reply.pop("obs")
+                reply.pop("rgb_array", None)
+                if r["cmd"] == "reset":
+                    reward, done = 0.0, False
+                else:
+                    reward = float(reply.pop("reward", 0.0))
+                    done = bool(reply.pop("done", False))
+                if done:
+                    self._needs_reset[i] = True
+                    # frames already queued behind the terminal one belong
+                    # to the dead episode: consume their replies silently
+                    # (they carry post-terminal state and extra dones).
+                    # The count rides the terminal transition's info so a
+                    # constant-depth driver can top up its resubmission —
+                    # without it the env's pipeline shrinks by this many
+                    # slots at every episode boundary.
+                    dropped = 0
+                    for rr in dq:
+                        if not rr["discard"]:
+                            rr["discard"] = True
+                            dropped += 1
+                            self.counters.incr("inflight_discards")
+                    if dropped:
+                        reply["inflight_discarded"] = dropped
+                reply["healthy"] = True
+                self._ready.append({
+                    "env": i, "obs": self._last_obs[i], "reward": reward,
+                    "done": done, "info": reply,
+                })
+
+    def _synthetic_ready_locked(self, i):
+        """One synthetic transition for quarantined env ``i`` (lock
+        held): mirrors the lock-step synthetic slot, including the
+        exactly-once ``done``."""
+        done = i in self._pending_done
+        self._pending_done.discard(i)
+        self._needs_reset[i] = False
+        return {
+            "env": i, "obs": self._synthetic_obs(i), "reward": 0.0,
+            "done": done, "info": {"healthy": False, "quarantined": True},
+        }
+
+    def _assemble_ready(self, entries):
+        idx = np.asarray([e["env"] for e in entries], dtype=np.intp)
+        if not entries:
+            template = next(
+                (o for o in self._last_obs if o is not None), None
+            )
+            return (
+                idx,
+                _empty_batch_like(template) if template is not None
+                else np.empty((0,), np.float32),
+                np.empty((0,), np.float32),
+                np.empty((0,), bool),
+                [],
+            )
+        return (
+            idx,
+            collate([e["obs"] for e in entries]),
+            np.asarray([e["reward"] for e in entries], np.float32),
+            np.asarray([e["done"] for e in entries], bool),
+            [e["info"] for e in entries],
         )
 
     def _synthetic_obs(self, i):
@@ -643,6 +1308,10 @@ class EnvPool:
         # behavior, and probe phases are bounded by block_ms
         with self._lock:
             socks, self.sockets = self.sockets, []
+            dealers, self._dealers = self._dealers, [None] * self.num_envs
+            for dq in self._inflight:
+                dq.clear()
+            self._ready.clear()
         deadline = time.monotonic() + 2.0
         while time.monotonic() < deadline:
             with self._lock:
@@ -652,6 +1321,9 @@ class EnvPool:
         with self._lock:
             for s in socks:
                 s.close(0)
+            for s in dealers:
+                if s is not None:
+                    s.close(0)
 
     def __enter__(self):
         return self
@@ -673,6 +1345,7 @@ def launch_env_pool(
     fault_policy=None,
     quarantine=True,
     counters=None,
+    pipeline_depth=1,
     **kwargs,
 ):
     """Launch N Blender env instances and yield a connected EnvPool.
@@ -700,6 +1373,7 @@ def launch_env_pool(
             fault_policy=fault_policy,
             quarantine=quarantine,
             counters=counters,
+            pipeline_depth=pipeline_depth,
         )
         try:
             yield pool

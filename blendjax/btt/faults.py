@@ -22,11 +22,17 @@ fault schedule produce the same backoff sequence — the chaos tests rely
 on this.
 
 Caveat for non-idempotent RPCs: a retry *re-sends* the request.  For
-``reset``/probe traffic that is idempotent; for ``step`` a retry against a
-slow-but-alive producer can advance the simulation an extra frame (the
-stale reply is dropped by REQ_CORRELATE).  Fleets whose envs cannot
-tolerate that should run ``FaultPolicy(max_retries=0)`` and rely on
-quarantine + re-admission alone.
+``reset``/probe traffic that is idempotent; for ``step`` the re-send
+carries the SAME correlation id (``wire.BTMID_KEY`` — ``RemoteEnv``
+stamps it whenever a policy is attached, the pipelined ``EnvPool``
+always), so a producer-side :class:`~blendjax.btb.env.RemoteControlledAgent`
+that already simulated the frame re-serves its cached reply instead of
+stepping twice — the retry is exactly-once at the simulation level.
+Third-party producers that ignore the id keep the old behavior (a
+slow-but-alive producer can advance one extra frame; the stale reply is
+dropped by REQ_CORRELATE); fleets of those that cannot tolerate it
+should run ``FaultPolicy(max_retries=0)`` and rely on quarantine +
+re-admission alone.
 """
 
 from __future__ import annotations

@@ -191,6 +191,15 @@ class FleetSupervisor:
             mask = self.pool.healthy
             h["num_envs"] = int(mask.size)
             h["healthy_envs"] = int(mask.sum())
+            # async pipeline observability: how deep each env's in-flight
+            # queue currently is vs. the configured ceiling
+            depths = getattr(self.pool, "inflight", None)
+            if depths is not None:
+                h["inflight_per_env"] = list(depths)
+                h["inflight_total"] = int(sum(depths))
+                h["pipeline_depth"] = int(
+                    getattr(self.pool, "pipeline_depth", 1)
+                )
         h["checks"] = {name: bool(fn()) for name, fn in self._checks.items()}
         return h
 

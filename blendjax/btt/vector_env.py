@@ -13,6 +13,13 @@ Autoreset follows gymnasium's NEXT_STEP mode, which is exactly
 observation with ``terminations[i] = True``; the reset happens on the
 *next* ``step`` call, which returns the fresh observation with zero
 reward.
+
+The gymnasium ``step_async``/``step_wait`` pair is implemented over the
+pool's pipelined DEALER path (docs/rl_stepping.md): between the two
+calls the whole fleet is simulating its next frame, so vectorized
+trainers that compute anything in that window (advantage math, buffer
+writes, logging) get it for free.  ``step`` remains the lock-step
+REQ/REP path.
 """
 
 from __future__ import annotations
@@ -87,8 +94,8 @@ if _gym is not None:
             obs, infos = self._pool.reset()
             return self._as_batched(obs), {"env_infos": infos}
 
-        def step(self, actions):
-            obs, rewards, dones, infos = self._pool.step(list(actions))
+        @staticmethod
+        def _route_dones(obs, rewards, dones, infos):
             dones = np.asarray(dones, dtype=bool)
             # a quarantine done is an episode cut short (producer died /
             # hung), not a task-terminal state: gymnasium-conformant
@@ -99,12 +106,30 @@ if _gym is not None:
             ) & dones
             terminations = dones & ~truncations
             return (
-                self._as_batched(obs),
+                BlenderVectorEnv._as_batched(obs),
                 rewards,
                 terminations,
                 truncations,
                 {"env_infos": infos},
             )
+
+        def step(self, actions):
+            return self._route_dones(*self._pool.step(list(actions)))
+
+        def step_async(self, actions):
+            """Submit the batch without waiting (gymnasium vector pair).
+
+            The fleet simulates while the caller computes; collect with
+            :meth:`step_wait`.  Backed by ``EnvPool.step_async`` — the
+            producers integrate physics for frame t+1 concurrently with
+            whatever runs between the two calls.
+            """
+            self._pool.step_async(list(actions))
+
+        def step_wait(self):
+            """Collect the batch submitted by :meth:`step_async`; same
+            5-tuple (and autoreset/truncation routing) as :meth:`step`."""
+            return self._route_dones(*self._pool.step_wait_full())
 
         def close_extras(self, **kwargs):
             self._pool.close()

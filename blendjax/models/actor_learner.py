@@ -53,15 +53,23 @@ class ActorLearner:
     action_map: callable | None
         Maps the sampled action array (shape (N,)) to the list the
         producers expect (e.g. discrete index -> motor force).
+    pipeline: bool
+        Double-buffer rollout collection over the pool's async path
+        (``step_async``/``step_wait_full``): actions are submitted first
+        and the fleet simulates frame t+1 while the actor finalizes the
+        previous segment (the ``np.stack`` + queue handoff — including
+        any block on a full queue — happens inside the simulation
+        window).  False keeps the lock-step ``pool.step`` loop.
     """
 
     def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
                  queue_size=4, optimizer=None, gamma=0.99, seed=0,
-                 continuous=False, action_map=None):
+                 continuous=False, action_map=None, pipeline=False):
         self.pool = pool
         self.rollout_len = rollout_len
         self.gamma = gamma
         self.continuous = continuous
+        self.pipeline = bool(pipeline)
         self.action_map = action_map or (lambda a: list(np.asarray(a)))
         params = policy.init(
             jax.random.PRNGKey(seed), obs_dim, num_actions,
@@ -111,6 +119,18 @@ class ActorLearner:
 
     # -- actor side --------------------------------------------------------
 
+    def _enqueue_segment(self, seg_lists):
+        """Stack a finished segment and hand it to the learner (bounded
+        put, re-checked against stop).  Returns False once stop is set."""
+        seg = tuple(np.stack(col) for col in seg_lists)
+        while not self._stop.is_set():
+            try:
+                self._q.put(seg, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _actor(self):
         try:
             # derived from the constructor seed: runs are reproducible
@@ -121,15 +141,33 @@ class ActorLearner:
             obs = np.asarray(obs, np.float32)
             if obs.ndim == 1:
                 obs = obs[:, None]
+            pending_seg = None  # finished segment owed to the learner
             while not self._stop.is_set():
                 seg_obs, seg_act, seg_rew, seg_done = [], [], [], []
                 params = self._actor_params  # snapshot for whole segment
                 for _ in range(self.rollout_len):
                     action, _logp, rng = self._sample(params, rng, obs)
                     action = np.asarray(action)
-                    nobs, rew, done, infos = self.pool.step(
-                        self.action_map(action)
-                    )
+                    if self.pipeline:
+                        # double-buffer: submit first, so the fleet
+                        # simulates frame t+1 while this thread finalizes
+                        # segment t (the stack + queue handoff below can
+                        # even block on a full queue — the envs keep
+                        # integrating physics through the stall)
+                        self.pool.step_async(self.action_map(action))
+                        if pending_seg is not None:
+                            if not self._enqueue_segment(pending_seg):
+                                # stop arrived with a batch in flight:
+                                # drain it so the pool is reusable for
+                                # lock-step callers after run() returns
+                                self.pool.step_wait()
+                                return
+                            pending_seg = None
+                        nobs, rew, done, infos = self.pool.step_wait_full()
+                    else:
+                        nobs, rew, done, infos = self.pool.step(
+                            self.action_map(action)
+                        )
                     # degraded-mode accounting: quarantined slots return
                     # synthetic zero-reward transitions (see
                     # docs/fault_tolerance.md) — surface how much of the
@@ -157,18 +195,13 @@ class ActorLearner:
                     if obs.ndim == 1:
                         obs = obs[:, None]
                     self._env_steps += self.pool.num_envs
-                seg = (
-                    np.stack(seg_obs),
-                    np.stack(seg_act),
-                    np.stack(seg_rew),
-                    np.stack(seg_done),
-                )
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(seg, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
+                seg_lists = (seg_obs, seg_act, seg_rew, seg_done)
+                if self.pipeline:
+                    # deferred into the next submission's simulation window
+                    pending_seg = seg_lists
+                else:
+                    if not self._enqueue_segment(seg_lists):
+                        return
         except BaseException as exc:  # noqa: BLE001 - surfaced by learner
             self._actor_error = exc
             self._stop.set()

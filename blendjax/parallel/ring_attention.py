@@ -392,13 +392,19 @@ def _ring_flash_fwd_windowed(q, k, v, axis_name, scale, interpret,
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd_windowed(axis_name, scale, interpret, vary_axes,
-                             window, res, g):
+def _ring_flash_bwd_windowed(axis_name, scale, interpret, window, res, g):
     """Backward of the windowed ring: dK/dV accumulators TRAVEL with
     their shard for the ``dmax`` rotations (each visiting device adds
     its pair's contribution), then a single ``ppermute`` jumps every
     accumulator straight home — ``dmax + 1`` collectives per gradient
-    array instead of the full ring's ``n``."""
+    array instead of the full ring's ``n``.
+
+    Takes no ``vary_axes`` (unlike the forwards): every accumulator is
+    seeded from ``pair_grads`` outputs, which are already device-varying
+    (they consume the per-device ``q``/``k``/``v`` shards), so no
+    ``_pvary`` seeding is needed — a zeros-init refactor would reintroduce
+    the shard_map varying-axis mismatch and must re-thread ``vary_axes``
+    through here."""
     from blendjax.ops.flash_attention import (
         _default_scale,
         _dkv_pass,
@@ -464,7 +470,7 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, vary_axes,
                     window, res, g):
     if window is not None:
         return _ring_flash_bwd_windowed(
-            axis_name, scale, interpret, vary_axes, window, res, g
+            axis_name, scale, interpret, window, res, g
         )
     from blendjax.ops.flash_attention import (
         _default_scale,

@@ -49,6 +49,15 @@ FLEET_EVENTS = (
     "deaths", "restarts", "retries", "timeouts", "failures", "quarantines",
     "readmissions", "circuit_opens", "circuit_rejections",
     "stream_timeouts", "stream_ring_vanished", "transfer_gate_backstops",
+    # async env pipeline (EnvPool.step_async/step_wait):
+    # ``ready_waits`` — step_wait calls that actually blocked for a reply;
+    # ``stale_replies`` — replies with no matching in-flight request
+    # (duplicate delivery, or orphaned by a quarantine drain);
+    # ``inflight_discards`` — in-flight requests consumed without
+    # surfacing a real transition (quarantine drain, post-``done`` frames,
+    # pipeline flush); a reply lost ahead of an out-of-order match is NOT
+    # discarded — it is re-sent and answered from the producer reply cache
+    "ready_waits", "stale_replies", "inflight_discards",
 )
 
 

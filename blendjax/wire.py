@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import random as _random
 
 import numpy as np
 import zmq
@@ -61,9 +62,30 @@ def is_array_placeholder(obj) -> bool:
     return isinstance(obj, dict) and _ARRAY_PLACEHOLDER in obj
 
 
+#: producer-side duplicate-suppression window, in replies: a retried
+#: request (same :data:`BTMID_KEY`) is answered from the producer's
+#: reply cache only while its reply is among the newest
+#: ``REPLY_CACHE_DEPTH`` served.  A protocol constant, not a tunable —
+#: the consumer's ``pipeline_depth`` must stay within it or a retry of
+#: the oldest in-flight request could re-simulate a frame.
+REPLY_CACHE_DEPTH = 8
+
+#: process-local generator seeded once from the OS: a per-message
+#: ``os.urandom`` costs ~100 us under syscall-intercepting sandboxes,
+#: which the pipelined EnvPool would pay per request — ``getrandbits``
+#: is pure user-space after the seed
+_MID_RNG = _random.Random(os.urandom(16))
+
+
 def new_message_id() -> str:
-    """Random 4-byte hex message id (reference ``duplex.py:63``)."""
-    return os.urandom(4).hex()
+    """Random 8-byte hex message id, drawn syscall-free from a
+    process-local OS-seeded generator.  The reference's 4 bytes
+    (``duplex.py:63``) sufficed for stale-reply detection, but the ids
+    now key the producer's exactly-once reply cache: a fresh id
+    colliding with one of the :data:`REPLY_CACHE_DEPTH` cached ids
+    would silently serve a stale transition, so the width keeps that
+    chance negligible over multi-day kHz-rate runs."""
+    return f"{_MID_RNG.getrandbits(64):016x}"
 
 
 def dumps(obj) -> bytes:
@@ -147,6 +169,49 @@ def send_message(socket: zmq.Socket, data: dict, raw_buffers: bool = False, flag
 def recv_message(socket: zmq.Socket, flags: int = 0) -> dict:
     frames = socket.recv_multipart(flags=flags, copy=False)
     return decode([f.buffer for f in frames])
+
+
+def stamp_message_id(data: dict) -> str:
+    """Stamp ``data`` with a fresh correlation id under :data:`BTMID_KEY`
+    and return it.  The async env pipeline uses this to match replies to
+    in-flight requests (and the producer-side agent to dedupe re-sent
+    ``step`` requests); receivers that ignore the key keep working."""
+    mid = new_message_id()
+    data[BTMID_KEY] = mid
+    return mid
+
+
+# ---------------------------------------------------------------------------
+# DEALER <-> REP framing
+# ---------------------------------------------------------------------------
+#
+# A DEALER socket talking to a REP peer must emulate the REQ envelope: an
+# empty delimiter frame ahead of the message body.  The REP socket strips
+# it on the way in and restores it on the way out, so existing REP-socket
+# producers (``blendjax.btb.env.RemoteControlledAgent``) serve DEALER
+# clients unmodified.  Unlike REQ, a DEALER has no strict send/recv
+# alternation — which is exactly what the pipelined EnvPool needs to keep
+# several requests in flight per env.
+
+
+def send_message_dealer(socket: zmq.Socket, data: dict,
+                        raw_buffers: bool = False, flags: int = 0):
+    """Send ``data`` from a DEALER socket to a REP peer (empty-delimiter
+    framing).  RPC control messages are small, so ``copy=True`` skips
+    pyzmq's zero-copy Frame bookkeeping (measurably cheaper per message);
+    bulk ndarray traffic belongs on the raw-buffer data plane, not here."""
+    frames = encode(data, raw_buffers=raw_buffers)
+    socket.send_multipart([b""] + frames, flags=flags,
+                          copy=not raw_buffers)
+
+
+def recv_message_dealer(socket: zmq.Socket, flags: int = 0) -> dict:
+    """Receive a REP peer's reply on a DEALER socket, stripping the
+    empty delimiter frame the REP socket re-attached."""
+    bufs = socket.recv_multipart(flags=flags, copy=True)
+    if bufs and len(bufs[0]) == 0:
+        bufs = bufs[1:]
+    return decode(bufs)
 
 
 def recv_message_raw(socket: zmq.Socket, flags: int = 0):

@@ -19,9 +19,13 @@ from blendjax.btb.env import BaseEnv, RemoteControlledAgent  # noqa: E402
 
 class EchoEnv(BaseEnv):
     """obs == last applied action; reward == action / 10; episode horizon
-    set by the frame range.  ``physics_us > 0`` busy-waits that long per
+    set by the frame range.  ``physics_us > 0`` sleeps that long per
     applied step, standing in for a physics solver's per-frame cost (the
-    RL benchmark's ``includes_physics`` configuration)."""
+    RL benchmark's ``includes_physics`` configuration).  Sleeping, not
+    spinning: in deployment the solver burns a *producer host's* CPU,
+    not the consumer's, so on a small CI box a spin here would measure
+    core oversubscription instead of the per-frame latency the RL
+    benchmark is about."""
 
     def __init__(self, agent, physics_us=0):
         super().__init__(agent)
@@ -36,9 +40,7 @@ class EchoEnv(BaseEnv):
         if self.physics_us > 0:
             import time
 
-            end = time.perf_counter() + self.physics_us / 1e6
-            while time.perf_counter() < end:
-                pass
+            time.sleep(self.physics_us / 1e6)
 
     def _env_post_step(self):
         return {

@@ -51,3 +51,35 @@ def test_actor_learner_improves_and_overlaps(fake_blender):
     last = np.mean(stats["segment_rewards"][-5:])
     assert last > first
     assert last > 0.08, f"policy failed to converge: {last}"
+
+
+def test_actor_learner_pipelined_double_buffer(fake_blender):
+    """pipeline=True routes rollout collection through the pool's async
+    step_async/step_wait path (envs simulate t+1 while the actor
+    finalizes segment t): training still works end to end and the echo
+    policy still improves."""
+    values = np.array([0.0, 1.0], np.float64)
+    with launch_env_pool(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        background=True,
+        horizon=1_000_000,
+        timeoutms=30000,
+        start_port=14810,
+        pipeline_depth=2,
+    ) as pool:
+        al = ActorLearner(
+            pool, obs_dim=1, num_actions=2, rollout_len=16,
+            seed=1, action_map=lambda a: list(values[np.asarray(a)]),
+            pipeline=True,
+        )
+        stats = al.run(num_updates=30)
+
+    assert stats["updates"] == 30
+    assert stats["env_steps"] > 30 * 16 * 2  # actor ran ahead: overlap
+    assert stats["unhealthy_env_steps"] == 0
+    first = np.mean(stats["segment_rewards"][:5])
+    last = np.mean(stats["segment_rewards"][-5:])
+    assert last > first
+    assert last > 0.08, f"policy failed to converge: {last}"

@@ -338,3 +338,33 @@ def test_banked_partial_records_disclose_truncation():
               "flash_over_full", "seq_partial", "topk_over_dense",
               "moe_partial"):
         assert k in line, k
+
+
+def test_rl_pipelined_compare_line_carries_through():
+    """The --compare microbench line (rl_pipelined_x IS the value) must
+    reach the extras and the headline; a single-mode pipelined line
+    falls back to the drift-prone ratio against the lock-step phase."""
+    phases = _tpu_phases()
+    out = assemble(
+        phases,
+        rl={"value": 9900.0, "vs_baseline": 4.95},
+        rl_physics={"value": 2872.0, "vs_baseline": 1.44},
+        rl_pipelined={
+            "metric": "rl_pipelined_x", "value": 2.18,
+            "pipeline_depth": 4, "pipelined_steps_per_sec": 1246.8,
+        },
+    )
+    assert out["rl_pipelined_x"] == 2.18
+    assert out["rl_pipeline_depth"] == 4
+    assert out["rl_steps_per_sec_pipelined"] == 1246.8
+    assert headline(out)["rl_pipelined_x"] == 2.18
+
+    out2 = assemble(
+        phases,
+        rl={"value": 9900.0, "vs_baseline": 4.95},
+        rl_physics={"value": 2000.0, "vs_baseline": 1.0},
+        rl_pipelined={"metric": "rl_steps_per_sec_pipelined",
+                      "value": 5000.0, "pipeline_depth": 4},
+    )
+    assert out2["rl_steps_per_sec_pipelined"] == 5000.0
+    assert out2["rl_pipelined_x"] == 2.5

@@ -25,7 +25,8 @@ def test_roundtrip_and_stamping():
     prod, cons = _pair()
     try:
         mid = cons.send(payload={"x": 1})
-        assert isinstance(mid, str) and len(mid) == 8
+        # 8-byte hex: ids key the producer reply cache (wire.new_message_id)
+        assert isinstance(mid, str) and len(mid) == 16
         msg = prod.recv(timeoutms=5000)
         assert msg["btid"] == 0 and msg["btmid"] == mid
         assert msg["payload"] == {"x": 1}

@@ -1,0 +1,679 @@
+"""Async pipelined EnvPool tests (docs/rl_stepping.md).
+
+Covers the step_async/step_wait DEALER path end to end against the real
+producer stack (fake-Blender fleet speaking the real wire protocol):
+lock-step bit-identity, ready-first partial batches, out-of-order reply
+routing through ChaosProxy stalls, mid-flight kill -> quarantine ->
+re-admission at full pipeline depth (both quarantine and strict modes),
+and the producer-side correlation-id dedupe that makes retried ``step``
+requests exactly-once.
+"""
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+from blendjax.btt.chaos import ChaosProxy, kill_instance, wait_env_ready
+from blendjax.btt.envpool import EnvPool, launch_env_pool
+from blendjax.btt.faults import FaultPolicy
+from blendjax.btt.launcher import BlenderLauncher
+from blendjax.btt.supervise import FleetSupervisor
+from blendjax.utils.timing import EventCounters
+from helpers import BLEND_SCRIPTS, FAKE_BLENDER
+
+ENV_SCRIPT = f"{BLEND_SCRIPTS}/env.blend.py"
+
+
+@pytest.fixture
+def fake_blender(monkeypatch):
+    monkeypatch.setenv("BLENDJAX_BLENDER", FAKE_BLENDER)
+
+
+def _drive_lockstep(pool, action_rounds):
+    out = []
+    for actions in action_rounds:
+        obs, rew, done, infos = pool.step(list(actions))
+        out.append((
+            np.asarray(obs).copy(), np.asarray(rew).copy(),
+            np.asarray(done).copy(),
+            [(i.get("time"), i.get("frame")) for i in infos],
+        ))
+    return out
+
+
+def _drive_async(pool, action_rounds):
+    out = []
+    for actions in action_rounds:
+        pool.step_async(list(actions))
+        obs, rew, done, infos = pool.step_wait_full()
+        out.append((
+            np.asarray(obs).copy(), np.asarray(rew).copy(),
+            np.asarray(done).copy(),
+            [(i.get("time"), i.get("frame")) for i in infos],
+        ))
+    return out
+
+
+def test_async_lockstep_bit_identical(fake_blender):
+    """The acceptance parity check: driven over the same deterministic
+    fleet (EchoEnv), the async path's full-batch mode produces byte-for-
+    byte the transitions the lock-step ``step()`` path produces —
+    including an autoreset boundary inside the window."""
+    rounds = [
+        [1.0, 2.0], [2.0, 3.0], [3.0, 1.0], [1.5, 2.5],  # crosses done@6
+        [4.0, 5.0], [0.5, 0.25], [6.0, 7.0],
+    ]
+    with launch_env_pool(
+        scene="", script=ENV_SCRIPT, num_instances=2, background=True,
+        horizon=6, timeoutms=30000, start_port=13200, pipeline_depth=2,
+    ) as pool:
+        pool.reset()
+        lockstep = _drive_lockstep(pool, rounds)
+        pool.reset()  # restart the episode: the fixture is deterministic
+        asynced = _drive_async(pool, rounds)
+    for (lo, lr, ld, li), (ao, ar, ad, ai) in zip(lockstep, asynced):
+        np.testing.assert_array_equal(lo, ao)
+        assert lo.dtype == ao.dtype
+        np.testing.assert_array_equal(lr, ar)
+        assert lr.dtype == ar.dtype
+        np.testing.assert_array_equal(ld, ad)
+        assert li == ai  # per-env clocks advanced identically
+
+
+def test_pipelined_depth2_ready_first_and_monotonic(fake_blender):
+    """Depth-2 pipelining: ready-first collection with indices, per-env
+    FIFO ordering, monotonic per-env clocks, and depth accounting."""
+    with launch_env_pool(
+        scene="", script=ENV_SCRIPT, num_instances=2, background=True,
+        horizon=1_000_000, timeoutms=30000, start_port=13220,
+        pipeline_depth=2,
+    ) as pool:
+        pool.reset()
+        pool.step_async([1.0, 2.0])
+        pool.step_async([3.0, 4.0])
+        assert pool.inflight == [2, 2]
+        # over-depth submission is a programming error
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.step_async([9.0, 9.0])
+        times = {0: [], 1: []}
+        seen = {0: [], 1: []}
+        collected = 0
+        while collected < 4:
+            idx, obs, rew, done, infos = pool.step_wait(min_ready=1)
+            assert len(idx) >= 1
+            for j, i in enumerate(idx):
+                i = int(i)
+                times[i].append(infos[j]["time"])
+                seen[i].append(float(np.asarray(obs).reshape(-1)[j]))
+                assert infos[j]["healthy"]
+            collected += len(idx)
+        assert pool.inflight == [0, 0]
+        # each transition landed at the env that was sent its action,
+        # oldest first (EchoEnv: obs == the action that produced it)
+        assert seen[0] == [1.0, 3.0]
+        assert seen[1] == [2.0, 4.0]
+        for ts in times.values():
+            assert ts == sorted(ts) and len(set(ts)) == len(ts)
+        # lock-step step() refuses to interleave with a live pipeline
+        pool.step_async([5.0, 5.0])
+        with pytest.raises(RuntimeError, match="in flight"):
+            pool.step([6.0, 6.0])
+        pool.step_wait()
+        # mismatched indices/actions lengths
+        with pytest.raises(ValueError, match="expected 2 actions"):
+            pool.step_async([1.0])
+        with pytest.raises(ValueError, match="indices"):
+            pool.step_async([1.0, 2.0], indices=[0])
+
+
+@pytest.mark.chaos
+def test_out_of_order_replies_route_by_correlation(fake_blender):
+    """ChaosProxy stalls reorder completion across envs: replies must
+    land at the right env index regardless of arrival order, with
+    ``env_times`` monotonic per env."""
+    policy = FaultPolicy(max_retries=1, deadline_s=5.0, jitter=0.0,
+                         circuit_threshold=0, seed=3)
+    with BlenderLauncher(
+        scene="", script=ENV_SCRIPT, num_instances=3,
+        named_sockets=["GYM"], start_port=13240, background=True,
+        instance_args=[["--horizon", "100000"]] * 3,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        with ChaosProxy(addrs[0], seed=5) as proxy:
+            counters = EventCounters()
+            pool = EnvPool(
+                [proxy.address, addrs[1], addrs[2]], timeoutms=10000,
+                fault_policy=policy, counters=counters, pipeline_depth=2,
+            )
+            try:
+                pool.reset()
+                times = {i: [] for i in range(3)}
+                for round_no in range(4):
+                    actions = [10.0 * (round_no + 1) + i for i in range(3)]
+                    proxy.stall()  # env 0's replies held back
+                    pool.step_async(actions)
+                    # the two unstalled envs complete first: ready-first
+                    # returns them without blocking on the straggler
+                    idx, obs, rew, done, infos = pool.step_wait(min_ready=2)
+                    got = {int(i) for i in idx}
+                    assert 0 not in got and got <= {1, 2}
+                    for j, i in enumerate(idx):
+                        i = int(i)
+                        assert float(np.asarray(obs)[j]) == actions[i]
+                        times[i].append(infos[j]["time"])
+                    proxy.resume()
+                    # the straggler lands at ITS index, out of submission
+                    # order vs the batch that already returned
+                    while len(times[0]) <= round_no:
+                        idx, obs, rew, done, infos = pool.step_wait(
+                            min_ready=1
+                        )
+                        for j, i in enumerate(idx):
+                            i = int(i)
+                            assert float(np.asarray(obs)[j]) == actions[i]
+                            times[i].append(infos[j]["time"])
+                assert counters.get("quarantines") == 0
+                for i, ts in times.items():
+                    assert len(ts) == 4
+                    assert ts == sorted(ts) and len(set(ts)) == len(ts), (
+                        f"env {i} clock not monotonic: {ts}"
+                    )
+                assert pool.healthy.all()
+            finally:
+                pool.close()
+
+
+def _policy(**kw):
+    base = dict(
+        max_retries=1, deadline_s=0.6, backoff_base=0.05,
+        backoff_factor=2.0, backoff_max=0.2, jitter=0.25,
+        circuit_threshold=0, seed=7,
+    )
+    base.update(kw)
+    return FaultPolicy(**base)
+
+
+@pytest.mark.chaos
+def test_kill_mid_flight_quarantine_and_full_depth_readmission(fake_blender):
+    """THE pipelined chaos acceptance: kill 1 of 3 producers with
+    requests in flight at depth 2.  The pipeline drains into synthetic
+    transitions (exactly one ``done=True``), survivors keep completing,
+    the supervisor respawns + re-admits, and the env rejoins at full
+    pipeline depth serving real transitions."""
+    with BlenderLauncher(
+        scene="", script=ENV_SCRIPT, num_instances=3,
+        named_sockets=["GYM"], start_port=13260, background=True,
+        instance_args=[["--horizon", "100000"]] * 3,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        counters = EventCounters()
+        # the victim sits behind a chaos proxy so the kill provably lands
+        # while its two requests are in flight (stall first, then kill)
+        with ChaosProxy(addrs[1], seed=11) as proxy:
+            pool = EnvPool(
+                [addrs[0], proxy.address, addrs[2]], timeoutms=10000,
+                fault_policy=_policy(), counters=counters, pipeline_depth=2,
+            )
+            with FleetSupervisor(
+                bl, pool=pool, interval=3.0, heal_interval=0.05,
+                counters=counters,
+            ) as sup:
+                try:
+                    _run_kill_mid_flight(bl, pool, sup, counters, proxy)
+                finally:
+                    pool.close()
+
+
+def _run_kill_mid_flight(bl, pool, sup, counters, proxy):
+    pool.reset()
+    pool.step_async([1.0, 1.0, 1.0])
+    pool.step_async([2.0, 2.0, 2.0])
+    idx, *_ = pool.step_wait()  # clean prime: 6 transitions
+    assert len(idx) == 6
+    assert counters.get("quarantines") == 0
+
+    # two requests provably in flight to the victim at death: the
+    # stalled proxy holds them short of the producer
+    proxy.stall()
+    pool.step_async([3.0, 3.0, 3.0])
+    pool.step_async([4.0, 4.0, 4.0])
+    assert pool.inflight == [2, 2, 2]
+    kill_instance(bl, 1)
+    proxy.resume()  # re-admission must flow once it respawns
+
+    env1_dones = 0
+    env1_synthetic = 0
+    readmitted = False
+    deadline = time.monotonic() + 120
+    while not readmitted and time.monotonic() < deadline:
+        idx, obs, rew, done, infos = pool.step_wait(min_ready=3)
+        for j, i in enumerate(idx):
+            i = int(i)
+            if i != 1:
+                assert infos[j]["healthy"]  # survivors never poisoned
+                continue
+            if done[j]:
+                env1_dones += 1
+            if not infos[j].get("healthy", True):
+                env1_synthetic += 1
+                assert rew[j] == 0.0
+            if infos[j].get("readmitted"):
+                readmitted = True
+        pool.step_async([5.0] * len(idx), indices=list(idx))
+    assert readmitted, f"no re-admission; health={sup.health()}"
+    # the interrupted episode closed exactly once
+    assert env1_dones == 1
+    assert env1_synthetic >= 1
+
+    # drain, then prove full-depth operation post-heal
+    pool.step_wait()
+    pool.step_async([7.0, 8.0, 9.0])
+    pool.step_async([7.5, 8.5, 9.5])
+    assert pool.inflight == [2, 2, 2]
+    got = {0: [], 1: [], 2: []}
+    while any(len(v) < 2 for v in got.values()):
+        idx, obs, rew, done, infos = pool.step_wait(min_ready=1)
+        for j, i in enumerate(idx):
+            got[int(i)].append(float(np.asarray(obs)[j]))
+            assert infos[j]["healthy"]
+    assert got[1] == [8.0, 8.5]  # real transitions again
+
+    h = sup.health()
+    assert h["quarantines"] == 1
+    assert h["readmissions"] == 1
+    assert h["deaths"] == 1 and h["restarts"] == 1
+    # the in-flight requests were drained into synthetics, not retried
+    # into the corpse forever
+    assert h["inflight_discards"] >= 2
+    assert h["pipeline_depth"] == 2
+    assert h["inflight_per_env"] == [0, 0, 0]
+    assert h["inflight_total"] == 0
+
+
+@pytest.mark.chaos
+def test_kill_mid_flight_strict_mode_raises_naming_env(fake_blender):
+    """quarantine=False: a producer dying with pipeline requests in
+    flight fails the wait with a ``TimeoutError`` naming the env, and
+    already-completed transitions survive for a later collection."""
+    with BlenderLauncher(
+        scene="", script=ENV_SCRIPT, num_instances=2,
+        named_sockets=["GYM"], start_port=13290, background=True,
+        instance_args=[["--horizon", "100000"]] * 2,
+    ) as bl:
+        addrs = bl.launch_info.addresses["GYM"]
+        wait_env_ready(addrs)
+        with ChaosProxy(addrs[0], seed=13) as proxy:
+            pool = EnvPool(
+                [proxy.address, addrs[1]], timeoutms=10000,
+                fault_policy=_policy(max_retries=0), quarantine=False,
+                counters=EventCounters(), pipeline_depth=2,
+            )
+            try:
+                pool.reset()
+                # hold env 0's requests on the wire, then kill it: the
+                # death provably lands with its pipeline full
+                proxy.stall()
+                pool.step_async([1.0, 2.0])
+                pool.step_async([3.0, 4.0])
+                assert pool.inflight == [2, 2]
+                kill_instance(bl, 0)
+                with pytest.raises(TimeoutError, match="environment 0"):
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        pool.step_wait(min_ready=4, timeout_ms=5000)
+                # env 1's completed transitions were committed, not lost
+                idx, obs, rew, done, infos = pool.step_wait(
+                    min_ready=1, timeout_ms=5000
+                )
+                assert {int(i) for i in idx} == {1}
+                assert [float(v) for v in np.asarray(obs)] == [2.0, 4.0]
+            finally:
+                pool.close()
+
+
+def test_agent_dedupes_resent_correlated_step():
+    """Producer-side exactly-once: a re-sent ``step`` carrying the same
+    correlation id (the consumer's retry path) is answered from the
+    reply cache instead of simulating the frame twice; the id is echoed
+    in every reply."""
+    import zmq
+
+    from blendjax import wire
+    from blendjax.btb.env import BaseEnv, RemoteControlledAgent
+    from helpers.producers import free_port
+
+    addr = f"tcp://127.0.0.1:{free_port()}"
+    agent = RemoteControlledAgent(addr, timeoutms=1000)
+    ctx = zmq.Context.instance()
+    dealer = ctx.socket(zmq.DEALER)
+    dealer.setsockopt(zmq.LINGER, 0)
+    dealer.setsockopt(zmq.RCVTIMEO, 5000)
+    dealer.connect(addr)
+    env = types.SimpleNamespace(state=BaseEnv.STATE_RUN)
+    try:
+        req_a = {"cmd": "step", "action": 3.5}
+        mid_a = wire.stamp_message_id(req_a)
+        wire.send_message_dealer(dealer, req_a)
+        # frame k: agent consumes the request and applies the action once
+        cmd, action = agent(env, obs=0.0, done=False)
+        assert (cmd, action) == (BaseEnv.CMD_STEP, 3.5)
+
+        # the consumer times out and re-sends the SAME correlated request,
+        # then (after the cached recovery) its next step
+        wire.send_message_dealer(dealer, dict(req_a))
+        req_b = {"cmd": "step", "action": 7.0}
+        wire.stamp_message_id(req_b)
+        wire.send_message_dealer(dealer, req_b)
+        time.sleep(0.2)
+
+        # frame k+1: reply for A goes out, the duplicate is served from
+        # cache (no second simulation), and B is the action applied
+        cmd, action = agent(env, obs=3.5, reward=0.35, done=False, time=9)
+        assert (cmd, action) == (BaseEnv.CMD_STEP, 7.0)
+
+        first = wire.recv_message_dealer(dealer)
+        dup = wire.recv_message_dealer(dealer)
+        assert first["obs"] == 3.5 and first["time"] == 9
+        assert first[wire.BTMID_KEY] == mid_a
+        assert dup == first  # byte-identical cached reply, frame NOT re-run
+
+        # frame k+2: B's reply arrives with B's id — the clock moved once
+        cmd, action = agent(env, obs=7.0, reward=0.7, done=False, time=10)
+        assert (cmd, action) == (BaseEnv.CMD_STEP, None)
+        reply_b = wire.recv_message_dealer(dealer)
+        assert reply_b["obs"] == 7.0 and reply_b["time"] == 10
+        assert reply_b[wire.BTMID_KEY] == req_b[wire.BTMID_KEY]
+    finally:
+        agent.close()
+        dealer.close(0)
+
+
+def test_lost_reply_recovered_in_order_without_resimulation(
+        fake_blender, monkeypatch):
+    """A reply lost on the wire ahead of an out-of-order match is
+    RECOVERED, not discarded: the newer reply is held for in-order
+    surfacing, the older request is re-sent under its original
+    correlation id, and the producer's reply cache answers it without
+    simulating the frame twice — every submission still yields exactly
+    one transition, in submission order, with a monotonic clock."""
+    import zmq
+
+    from blendjax import wire
+
+    counters = EventCounters()
+    policy = FaultPolicy(max_retries=2, deadline_s=8.0, backoff_base=0.05,
+                         jitter=0.0, circuit_threshold=0, seed=5)
+    with launch_env_pool(
+        scene="", script=ENV_SCRIPT, num_instances=1, background=True,
+        horizon=1_000_000, timeoutms=8000, start_port=13340,
+        pipeline_depth=3, fault_policy=policy, counters=counters,
+    ) as pool:
+        pool.reset()
+        pool.step_async([1.0, 2.0, 3.0], indices=[0, 0, 0])
+
+        real_recv = wire.recv_message_dealer
+        state = {"swallowed": False}
+
+        def lossy(sock, flags=0):
+            d = real_recv(sock, flags=flags)
+            if not state["swallowed"]:
+                state["swallowed"] = True  # reply 1.0 evaporates in transit
+                raise zmq.Again()
+            return d
+
+        monkeypatch.setattr("blendjax.wire.recv_message_dealer", lossy)
+        idx, obs, rew, done, infos = pool.step_wait(min_ready=3)
+        assert state["swallowed"]
+        assert list(idx) == [0, 0, 0]
+        # submission order held through the loss, and each frame was
+        # simulated exactly once (EchoEnv: obs == the action applied)
+        assert [float(v) for v in np.asarray(obs)] == [1.0, 2.0, 3.0]
+        times = [i["time"] for i in infos]
+        assert times == sorted(times) and len(set(times)) == 3
+        assert all(i["healthy"] for i in infos)
+        assert counters.get("retries") >= 1
+        assert counters.get("inflight_discards") == 0
+        assert pool.inflight == [0]
+        # the channel is still clean: a further round-trip works
+        pool.step_async([4.0], indices=[0])
+        idx, obs, *_ = pool.step_wait(min_ready=1)
+        assert float(np.asarray(obs)[0]) == 4.0
+
+
+def test_remote_env_policy_retry_is_exactly_once():
+    """Consumer-side half of the dedupe: a ``RemoteEnv`` under a
+    ``FaultPolicy`` stamps each logical call once, so its timeout-driven
+    re-send carries the same correlation id and the agent never
+    simulates the retried ``step`` a second time."""
+    import threading
+
+    import zmq  # noqa: F401 - transport under test
+
+    from blendjax.btb.env import BaseEnv, RemoteControlledAgent
+    from blendjax.btt.env import RemoteEnv
+    from helpers.producers import free_port
+
+    addr = f"tcp://127.0.0.1:{free_port()}"
+    agent = RemoteControlledAgent(addr, timeoutms=1000)
+    policy = FaultPolicy(max_retries=2, backoff_base=0.01, jitter=0.0,
+                         circuit_threshold=0, seed=1)
+    counters = EventCounters()
+    env_ns = types.SimpleNamespace(state=BaseEnv.STATE_RUN)
+    result = {}
+
+    def client():
+        renv = RemoteEnv(addr, timeoutms=300, fault_policy=policy,
+                         counters=counters)
+        try:
+            result["step"] = renv.step(3.5)
+        except BaseException as exc:  # surfaced by the main thread
+            result["error"] = exc
+        finally:
+            renv.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    try:
+        t.start()
+        # serve nothing until the client has timed out and re-sent: both
+        # copies of the request are now queued at the producer
+        time.sleep(0.45)
+        cmd, action = agent(env_ns, obs=0.0, done=False)
+        assert (cmd, action) == (BaseEnv.CMD_STEP, 3.5)
+        # next frame: the real reply goes out (the client's REQ_CORRELATE
+        # drops it as stale), the duplicate is answered from the cache,
+        # and NO second 3.5 step is handed to the simulation
+        cmd, action = agent(env_ns, obs=3.5, reward=0.35, done=False, time=9)
+        assert (cmd, action) == (BaseEnv.CMD_STEP, None)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert "error" not in result, result.get("error")
+        obs, reward, done, info = result["step"]
+        assert (obs, reward, done) == (3.5, 0.35, False)
+        assert info["time"] == 9
+        assert counters.get("retries") >= 1
+    finally:
+        agent.close()
+
+
+def test_legacy_producer_timeout_escalates_without_retry():
+    """A producer that does NOT echo ``wire.BTMID_KEY`` gets FIFO reply
+    matching, which a retry re-send would permanently shift off by one
+    (the legacy producer simulates both copies and the duplicate
+    mid-less reply matches the NEXT in-flight record): once the pool has
+    seen a mid-less reply from an env, an in-flight timeout escalates
+    straight to quarantine instead of re-sending."""
+    import threading
+
+    import zmq
+
+    from blendjax import wire
+    from helpers.producers import free_port
+
+    addr = f"tcp://127.0.0.1:{free_port()}"
+    stall = threading.Event()
+    stop = threading.Event()
+
+    def legacy_server():
+        ctx = zmq.Context.instance()
+        rep = ctx.socket(zmq.REP)
+        rep.setsockopt(zmq.LINGER, 0)
+        rep.setsockopt(zmq.RCVTIMEO, 100)
+        rep.bind(addr)
+        t = 0
+        try:
+            while not stop.is_set():
+                try:
+                    req = wire.recv_message(rep)
+                except zmq.Again:
+                    continue
+                if stall.is_set():
+                    # go silent mid-cycle: the request is consumed, no
+                    # reply ever comes
+                    stop.wait()
+                    break
+                t += 1
+                # reference-style reply: no BTMID_KEY echo (the first
+                # request is the autoreset contract's "reset")
+                obs = 0.0 if req["cmd"] == "reset" else req["action"]
+                wire.send_message(rep, {
+                    "obs": obs, "reward": 0.0, "done": False, "time": t,
+                })
+        finally:
+            rep.close(0)
+
+    thread = threading.Thread(target=legacy_server, daemon=True)
+    thread.start()
+    counters = EventCounters()
+    policy = FaultPolicy(max_retries=3, deadline_s=0.5, backoff_base=0.05,
+                         jitter=0.0, circuit_threshold=0, seed=7)
+    pool = EnvPool([addr], timeoutms=2000, fault_policy=policy,
+                   counters=counters, pipeline_depth=2)
+    try:
+        pool.step_async([1.0])
+        idx, obs, rew, done, infos = pool.step_wait(min_ready=1)
+        assert list(idx) == [0] and infos[0]["healthy"]
+        assert float(np.asarray(obs)[0]) == 0.0  # the autoreset "reset"
+
+        stall.set()  # the next request will be swallowed, never answered
+        pool.step_async([2.0])
+        idx, obs, rew, done, infos = pool.step_wait(min_ready=1)
+        # escalated to quarantine with ZERO re-sends, despite the policy
+        # allowing 3 retries — a retry's duplicate mid-less reply would
+        # corrupt FIFO matching for every later transition
+        assert counters.get("retries") == 0
+        assert counters.get("quarantines") == 1
+        assert list(idx) == [0]
+        assert bool(np.asarray(done)[0]) and not infos[0]["healthy"]
+        assert bool(pool.quarantined[0])
+    finally:
+        stop.set()
+        pool.close()
+        thread.join(timeout=3)
+
+
+def test_legacy_producer_retried_before_first_reply_fails_cleanly():
+    """The unknown-echo window: a retry that fires before an env's
+    first-ever reply is safe for blendjax producers (dedupe) but not for
+    legacy ones — when the late first reply then arrives mid-less, the
+    producer may have simulated the frame twice and FIFO attribution is
+    unrecoverable, so the env must fail cleanly (quarantine + synthetic
+    transitions) instead of serving shifted transitions."""
+    import threading
+
+    import zmq
+
+    from blendjax import wire
+    from helpers.producers import free_port
+
+    addr = f"tcp://127.0.0.1:{free_port()}"
+    stop = threading.Event()
+
+    def slow_legacy_server():
+        ctx = zmq.Context.instance()
+        rep = ctx.socket(zmq.REP)
+        rep.setsockopt(zmq.LINGER, 0)
+        rep.setsockopt(zmq.RCVTIMEO, 100)
+        rep.bind(addr)
+        try:
+            while not stop.is_set():
+                try:
+                    req = wire.recv_message(rep)
+                except zmq.Again:
+                    continue
+                # slower than the policy deadline: the consumer's retry
+                # goes out while echo support is still unknown
+                time.sleep(1.0)
+                obs = 0.0 if req["cmd"] == "reset" else req["action"]
+                wire.send_message(rep, {
+                    "obs": obs, "reward": 0.0, "done": False, "time": 1,
+                })
+        finally:
+            rep.close(0)
+
+    thread = threading.Thread(target=slow_legacy_server, daemon=True)
+    thread.start()
+    counters = EventCounters()
+    policy = FaultPolicy(max_retries=3, deadline_s=0.4, backoff_base=0.05,
+                         jitter=0.0, circuit_threshold=0, seed=7)
+    pool = EnvPool([addr], timeoutms=2000, fault_policy=policy,
+                   counters=counters, pipeline_depth=2)
+    try:
+        pool.step_async([1.0])
+        pool.step_async([2.0])
+        idx, obs, rew, done, infos = pool.step_wait(min_ready=2)
+        # the late mid-less first reply arrived AFTER a retry: both
+        # submissions resolve synthetically, never as shifted real rows
+        assert counters.get("retries") >= 1
+        assert counters.get("quarantines") == 1
+        assert list(idx) == [0, 0]
+        dones = list(np.asarray(done))
+        assert dones == [True, False]  # exactly-one quarantine done
+        assert not infos[0]["healthy"] and not infos[1]["healthy"]
+        assert bool(pool.quarantined[0])
+    finally:
+        stop.set()
+        pool.close()
+        thread.join(timeout=3)
+
+
+def test_vector_env_async_pair(fake_blender):
+    """The gymnasium step_async/step_wait pair over a Blender fleet:
+    same 5-tuple contract as step(), with the NEXT_STEP autoreset
+    boundary crossing the async path."""
+    gymnasium = pytest.importorskip("gymnasium")
+
+    from blendjax.btt.vector_env import launch_vector_env
+
+    obs_space = gymnasium.spaces.Box(
+        -np.inf, np.inf, shape=(), dtype=np.float64
+    )
+    act_space = gymnasium.spaces.Box(-10.0, 10.0, shape=(), dtype=np.float64)
+    with launch_vector_env(
+        scene="", script=ENV_SCRIPT, num_instances=2,
+        single_observation_space=obs_space, single_action_space=act_space,
+        background=True, horizon=4, timeoutms=30000, start_port=13310,
+        pipeline_depth=2,
+    ) as env:
+        env.reset()
+        env.step_async(np.array([1.0, 3.0]))
+        obs, rew, term, trunc, info = env.step_wait()
+        np.testing.assert_allclose(obs, [1.0, 3.0])
+        np.testing.assert_allclose(rew, [0.1, 0.3])
+        assert not term.any() and not trunc.any()
+        # drive to termination through the async pair
+        for _ in range(6):
+            env.step_async(np.array([2.0, 2.0]))
+            obs, rew, term, trunc, info = env.step_wait()
+            if term.any():
+                break
+        assert term.all()
+        # NEXT_STEP autoreset across the pair: fresh obs, zero reward
+        env.step_async(np.array([7.0, 7.0]))
+        obs, rew, term, trunc, info = env.step_wait()
+        np.testing.assert_allclose(obs, [0.0, 0.0])
+        np.testing.assert_allclose(rew, [0.0, 0.0])
+        assert not term.any()
