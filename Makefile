@@ -9,7 +9,7 @@ PYTHON ?= python
 # tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos blender-tests tpu-tests bench rlbench dryrun
+.PHONY: test tier1 chaos blender-tests tpu-tests bench rlbench replaybench dryrun
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -73,6 +73,14 @@ rlbench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/rl_benchmark.py \
 		--instances 4 --seconds 15 --physics-us 250 \
 		--compare --pipeline-depth 4
+
+# Jax-free replay-path microbench: appends/sec into the columnar ring,
+# batched columnar vs naive per-item sampling (replay_sample_x, floor
+# 2.0 at batch 32), and the FileRecorder buffered-vs-unbuffered write
+# comparison.  One JSON line; see docs/replay.md.
+replaybench:
+	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/replay_benchmark.py \
+		--batch 32 --seconds 6
 
 dryrun:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

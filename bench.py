@@ -204,6 +204,16 @@ def feed_bound_phase(seconds=3.0):
     return measure(seconds=seconds)
 
 
+def replay_bench_phase(seconds=5.0):
+    """Measure the replay subsystem (benchmarks/replay_benchmark.py):
+    ring append rate, batched columnar vs naive per-item sampling
+    (``replay_sample_x``), and the FileRecorder buffered-write win —
+    jax-free, in-process, same rationale as the feed-bound phase."""
+    from benchmarks.replay_benchmark import measure
+
+    return measure(seconds=seconds)
+
+
 def main():
     sys.path.insert(0, HERE)
     try:
@@ -229,6 +239,16 @@ def main():
         feed_bound = feed_bound_phase()
     except Exception as e:  # noqa: BLE001 - the suite phases still run
         sys.stderr.write(f"feed_bound phase failed: {type(e).__name__}: {e}\n")
+    # replay-path ceiling rides along under the same jax-free budget: the
+    # off-policy workload's sampling rate (and its columnar speedup) is a
+    # first-class headline next to the feed's
+    replay_bench = None
+    try:
+        replay_bench = replay_bench_phase()
+    except Exception as e:  # noqa: BLE001 - the suite phases still run
+        sys.stderr.write(
+            f"replay_bench phase failed: {type(e).__name__}: {e}\n"
+        )
     cores = os.cpu_count() or 1
     instances = 4 if cores >= 4 else 1
     workers = 4 if cores >= 4 else 1
@@ -311,7 +331,8 @@ def main():
         rl_pipelined = rl_lines[-1] if rl_lines else None
 
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
-                   feed_bound=feed_bound, rl_pipelined=rl_pipelined)
+                   feed_bound=feed_bound, rl_pipelined=rl_pipelined,
+                   replay_bench=replay_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -354,6 +375,7 @@ HEADLINE_ABBREV = (
 #: partial/degraded markers are never dropped.
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
+    ("replay_sample_x",),
     ("feed_arena_x",),
     ("rl_pipelined_x",),
     ("attn",),
@@ -375,6 +397,11 @@ def headline(out):
     if fb and fb.get("arena_over_legacy") is not None:
         # arena assembly speedup over legacy collate at the feed ceiling
         line["feed_arena_x"] = fb["arena_over_legacy"]
+    rb = out.get("replay_bench")
+    if rb and rb.get("replay_sample_x") is not None:
+        # columnar batched replay sampling speedup over naive per-item
+        # collation (batch 32) — the off-policy workload's feed ceiling
+        line["replay_sample_x"] = rb["replay_sample_x"]
     if out.get("rl_pipelined_x") is not None:
         # async pipelined EnvPool speedup over lock-step at physics 250us
         line["rl_pipelined_x"] = out["rl_pipelined_x"]
@@ -429,7 +456,7 @@ def headline(out):
 
 
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
-             feed_bound=None, rl_pipelined=None):
+             feed_bound=None, rl_pipelined=None, replay_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -441,6 +468,11 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
         # scatter / recycle), so the copy-elimination win is measurable
         # in the artifact rather than asserted
         extras["feed_bound"] = feed_bound
+    if replay_bench:
+        # the replay-path ceiling: ring append rate, columnar-vs-naive
+        # sampling (replay_sample_x), and the FileRecorder buffered-write
+        # before/after (record_buffered_x) — see benchmarks/replay_benchmark.py
+        extras["replay_bench"] = replay_bench
 
     def pick(name):
         # prefer the accelerator child's phase; fall back to the cpu

@@ -17,6 +17,23 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
+#: Result-schema keys every ``replay_benchmark.py`` JSON line carries
+#: (phase ``replay_bench``); ``bench.py`` and the suite consumers key off
+#: these, and ``tests/test_replay.py`` locks emission against this tuple
+#: so the artifact schema cannot drift silently.
+#: ``replay_sample_x`` is the headline: batched columnar sampling over
+#: naive per-item collation at the acceptance batch size (32).
+REPLAY_BENCH_KEYS = (
+    "frame", "batch", "capacity",
+    "replay_appends_per_sec",
+    "replay_batches_per_sec",   # {"naive": .., "columnar": ..}
+    "replay_samples_per_sec",   # same, in transitions/sec
+    "replay_sample_x",
+    "record_msgs_per_sec",      # {"unbuffered": .., "buffered": ..}
+    "record_buffered_x",
+    "stages",
+)
+
 
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)

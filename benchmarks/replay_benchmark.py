@@ -1,0 +1,261 @@
+"""Replay-path benchmark: append rate, sampling rate (columnar vs
+naive), and the record-path syscall tax — jax-free, in-process.
+
+Three measurements, one JSON line (phase ``replay_bench``, keys locked
+by ``benchmarks/_common.REPLAY_BENCH_KEYS``):
+
+- **appends/sec** — transitions into the columnar ring
+  (:class:`blendjax.replay.ReplayBuffer`), image-shaped observations;
+  this is the ceiling on actor-side feed rate into the buffer.
+- **sampled-batches/sec**, ``naive`` vs ``columnar`` — the tentpole
+  comparison.  Naive is the layout replay code without a columnar store
+  is forced into: materialize each sampled transition as its own dict
+  of copied arrays, then ``collate`` the list (per-item copies + a
+  stacking copy).  Columnar is ``ReplayBuffer.sample``: the same
+  deterministic draw, then ONE gather per key straight into batch
+  buffers.  Both run on the same buffer over interleaved A/B windows
+  and the ratio is reported at the median pair
+  (``replay_sample_x``, acceptance floor 2.0 at batch 32) — the same
+  drift-immunity scheme as ``feed_bound.py``.
+- **record msgs/sec**, ``unbuffered`` vs ``buffered`` — the
+  ``FileRecorder`` before/after for the buffered-writes change
+  (``buffering=0`` was one syscall per record; the default is now a
+  1 MiB write buffer flushed before the in-place header rewrite).
+  Reported as ``record_buffered_x``.
+
+Run via ``make replaybench`` (defaults below) or directly::
+
+    python benchmarks/replay_benchmark.py --batch 32 --seconds 6
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _transition(rng, height, width, channels, np):
+    img = rng.integers(0, 255, (height, width, channels), dtype=np.uint8)
+    nimg = rng.integers(0, 255, (height, width, channels), dtype=np.uint8)
+    return {
+        "obs": img,
+        "action": np.int32(rng.integers(0, 4)),
+        "reward": np.float32(rng.random()),
+        "next_obs": nimg,
+        "done": bool(rng.random() < 0.02),
+    }
+
+
+def _fill(buffer, transitions, n):
+    for k in range(n):
+        buffer.append(transitions[k % len(transitions)])
+
+
+def measure_append(width=160, height=120, channels=3, capacity=4096,
+                   seconds=1.0, seed=0):
+    """Transitions/sec into a fresh buffer (ring wraps mid-window, so
+    the rate includes steady-state evictions)."""
+    import numpy as np
+
+    from blendjax.replay import ReplayBuffer
+
+    rng = np.random.default_rng(seed)
+    transitions = [
+        _transition(rng, height, width, channels, np) for _ in range(64)
+    ]
+    buf = ReplayBuffer(capacity, seed=seed)
+    _fill(buf, transitions, 64)  # schema + first-touch outside the window
+    clock = time.perf_counter
+    n = 0
+    t0 = clock()
+    while clock() - t0 < seconds:
+        buf.append(transitions[n % 64])
+        n += 1
+    return n / (clock() - t0), buf
+
+
+def _run_naive(buffer, batch, seconds):
+    """Per-item sampling: same deterministic draw, then dict-per-item
+    materialization + list collate — the layout tax the columnar store
+    removes."""
+    from blendjax.btt.collate import collate
+
+    clock = time.perf_counter
+    n = 0
+    t0 = clock()
+    while clock() - t0 < seconds:
+        with buffer._cond:
+            idx, _w = buffer._draw_locked(batch, buffer.beta)
+        items = [buffer.store.read_row(int(i)) for i in idx]
+        out = collate(items)
+        out["obs"][0, 0, 0, 0]  # trivial consumer: touch the batch
+        n += 1
+    return n, clock() - t0
+
+
+def _run_columnar(buffer, batch, seconds):
+    """Production path: ``ReplayBuffer.sample`` (draw + one gather per
+    key) into REUSED destination buffers — the shape ``sample_batches``
+    ships, where every gather lands in a recycled arena buffer instead
+    of a fresh allocation (fresh 1-2 MB batches pay page faults that
+    the recycled path never sees)."""
+    import numpy as np
+
+    out = {}
+
+    def _dst(key, shape, dtype):
+        buf = out.get(key)
+        if buf is None or buf.shape != shape or buf.dtype != dtype:
+            buf = out[key] = np.empty(shape, dtype)
+        return buf
+
+    clock = time.perf_counter
+    n = 0
+    t0 = clock()
+    while clock() - t0 < seconds:
+        data, _idx, _w = buffer.sample(batch, out=_dst)
+        data["obs"][0, 0, 0, 0]
+        n += 1
+    return n, clock() - t0
+
+
+def measure_sample(buffer, batch=32, seconds=2.0):
+    """Interleaved A/B windows over one buffer; median-pair ratio."""
+    win = 0.25
+    rounds = max(4, int(seconds / (2 * win)))
+    _run_naive(buffer, batch, 0.1)      # warmup both paths
+    _run_columnar(buffer, batch, 0.1)
+    pairs = []
+    for _ in range(rounds):
+        nn, nt = _run_naive(buffer, batch, win)
+        cn, ct = _run_columnar(buffer, batch, win)
+        naive = nn / nt
+        columnar = cn / ct
+        if naive > 0:
+            pairs.append((columnar / naive, naive, columnar))
+    pairs.sort()
+    ratio, naive, columnar = pairs[len(pairs) // 2] if pairs else (0.0, 0.0, 0.0)
+    return {
+        "naive": round(naive, 2),
+        "columnar": round(columnar, 2),
+        "ratio": round(ratio, 3) if naive else None,
+    }
+
+
+def measure_record(width=160, height=120, channels=3, seconds=1.0,
+                   tmpdir=None, seed=0):
+    """FileRecorder msgs/sec, reference unbuffered vs buffered writes
+    (identical on-disk format either way)."""
+    import tempfile
+
+    import numpy as np
+
+    from blendjax.btt.file import FileRecorder
+    from blendjax.replay import transition_to_message
+
+    rng = np.random.default_rng(seed)
+    msgs = [
+        transition_to_message(_transition(rng, height, width, channels, np))
+        for _ in range(32)
+    ]
+    out = {}
+    with tempfile.TemporaryDirectory(dir=tmpdir) as td:
+        for label, buffering in (("unbuffered", 0), ("buffered", -2)):
+            kwargs = {} if buffering == -2 else {"buffering": buffering}
+            clock = time.perf_counter
+            n = 0
+            # capacity sized generously; windows are time-bound
+            with FileRecorder(
+                os.path.join(td, f"{label}.btr"), max_messages=1_000_000,
+                **kwargs,
+            ) as rec:
+                t0 = clock()
+                while clock() - t0 < seconds:
+                    rec.save(msgs[n % 32])
+                    n += 1
+                dt = clock() - t0
+            out[label] = n / dt
+    return out
+
+
+def measure(width=160, height=120, channels=3, batch=32, capacity=4096,
+            seconds=6.0, seed=0):
+    """The full replay_bench record (keys: ``REPLAY_BENCH_KEYS``)."""
+    from benchmarks._common import REPLAY_BENCH_KEYS
+
+    budget = max(seconds, 3.0)
+    appends_per_sec, buf = measure_append(
+        width, height, channels, capacity, seconds=0.15 * budget, seed=seed
+    )
+    sample = measure_sample(buf, batch=batch, seconds=0.55 * budget)
+    record = measure_record(
+        width, height, channels, seconds=0.15 * budget, seed=seed
+    )
+    rec = {
+        "frame": f"{width}x{height}x{channels}",
+        "batch": batch,
+        "capacity": capacity,
+        "replay_appends_per_sec": round(appends_per_sec, 1),
+        "replay_batches_per_sec": {
+            "naive": sample["naive"],
+            "columnar": sample["columnar"],
+        },
+        "replay_samples_per_sec": {
+            "naive": round(sample["naive"] * batch, 1),
+            "columnar": round(sample["columnar"] * batch, 1),
+        },
+        "replay_sample_x": sample["ratio"],
+        "record_msgs_per_sec": {
+            k: round(v, 1) for k, v in record.items()
+        },
+        "record_buffered_x": (
+            round(record["buffered"] / record["unbuffered"], 3)
+            if record.get("unbuffered")
+            else None
+        ),
+        "stages": buf.timer.summary(),
+    }
+    missing = [k for k in REPLAY_BENCH_KEYS if k not in rec]
+    assert not missing, f"replay_bench schema drifted: missing {missing}"
+    return rec
+
+
+def main():
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--width", type=int, default=160)
+    ap.add_argument("--height", type=int, default=120)
+    ap.add_argument("--channels", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--capacity", type=int, default=4096)
+    ap.add_argument("--seconds", type=float, default=6.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    print(
+        json.dumps(
+            {
+                "phase": "replay_bench",
+                **measure(
+                    width=args.width,
+                    height=args.height,
+                    channels=args.channels,
+                    batch=args.batch,
+                    capacity=args.capacity,
+                    seconds=args.seconds,
+                    seed=args.seed,
+                ),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -126,13 +126,20 @@ class ArenaBatch:
     and MUST only be called once the batch's bytes have been consumed —
     the prefetcher calls it after the device transfer completes
     (``jax.block_until_ready``).  Idempotent: double-recycle is a no-op.
+
+    ``meta`` carries producer-side sidecar values that live OUTSIDE the
+    batch pytree — e.g. the replay sampler's ``(indices, weights)``
+    pair, needed for priority updates after the learner step.  Consumers
+    that unwrap ``data`` (the device prefetcher) ignore it; direct
+    consumers read it before recycling.
     """
 
-    __slots__ = ("data", "arena")
+    __slots__ = ("data", "arena", "meta")
 
-    def __init__(self, data, arena):
+    def __init__(self, data, arena, meta=None):
         self.data = data
         self.arena = arena
+        self.meta = meta
 
     def recycle(self):
         arena, self.arena = self.arena, None

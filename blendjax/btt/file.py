@@ -25,6 +25,17 @@ from blendjax import wire
 logger = logging.getLogger("blendjax")
 
 
+#: Default write-buffer size.  The reference opens with ``buffering=0``
+#: — one syscall per ``write`` — which costs a measurable fraction of
+#: the record path at high message rates (small messages are worst:
+#: header + payload = 2+ syscalls each; see ``make replaybench``'s
+#: ``record_buffered_x`` for the measured before/after).  Buffered
+#: writes change nothing about the format: ``tell()`` on a
+#: ``BufferedWriter`` reports the logical position, and the close path
+#: flushes explicitly before the in-place header rewrite.
+DEFAULT_WRITE_BUFFER = 1 << 20
+
+
 class FileRecorder:
     """Context manager appending raw messages to an offset-indexed log.
 
@@ -34,21 +45,38 @@ class FileRecorder:
         File to write.
     max_messages: int
         Capacity; further ``save`` calls are dropped (matching reference
-        semantics, ``file.py:46``).
+        semantics, ``file.py:46``) — with a once-per-recorder warning,
+        a ``dropped`` count, and a ``record_drops`` event so the loss
+        is visible (the reference drops silently).
+    buffering: int
+        Passed to ``io.open``; 0 restores the reference's unbuffered
+        one-syscall-per-record behavior (kept for the before/after
+        benchmark comparison).
+    counters: EventCounters | None
+        Sink for ``record_drops``; defaults to the process-wide
+        ``blendjax.utils.timing.fleet_counters`` so
+        ``FleetSupervisor.health()`` surfaces truncated recordings.
     """
 
-    def __init__(self, outpath="blendjax.btr", max_messages=100000):
+    def __init__(self, outpath="blendjax.btr", max_messages=100000,
+                 buffering=DEFAULT_WRITE_BUFFER, counters=None):
+        from blendjax.utils.timing import fleet_counters
+
         outpath = Path(outpath)
         outpath.parent.mkdir(parents=True, exist_ok=True)
         self.outpath = outpath
         self.capacity = max_messages
+        self.buffering = buffering
         self.file = None
+        self.dropped = 0
+        self.counters = counters if counters is not None else fleet_counters
         logger.info("Recording to %s, capacity %d messages.", outpath, max_messages)
 
     def __enter__(self):
-        self.file = io.open(self.outpath, "wb", buffering=0)
+        self.file = io.open(self.outpath, "wb", buffering=self.buffering)
         self.offsets = np.full(self.capacity, -1, dtype=np.int64)
         self.num_messages = 0
+        self.dropped = 0
         self._write_header()
         return self
 
@@ -56,15 +84,30 @@ class FileRecorder:
         self.file.write(pickle.dumps(self.offsets, protocol=wire.PICKLE_PROTOCOL))
 
     def save(self, data, is_pickled=False):
-        """Append one message (dict, or already-pickled bytes)."""
+        """Append one message (dict, or already-pickled bytes).
+
+        Returns True when stored; False once ``capacity`` is reached —
+        the message is dropped (recording truncated, warned once per
+        recorder, counted in ``dropped`` / the ``record_drops`` event).
+        """
         if self.num_messages >= self.capacity:
-            return
+            if self.dropped == 0:
+                logger.warning(
+                    "FileRecorder %s is full (%d messages): further "
+                    "messages are DROPPED — the recording is truncated, "
+                    "raise max_messages to keep them.",
+                    self.outpath, self.capacity,
+                )
+            self.dropped += 1
+            self.counters.incr("record_drops")
+            return False
         self.offsets[self.num_messages] = self.file.tell()
         self.num_messages += 1
         if is_pickled:
             self.file.write(data)
         else:
             self.file.write(pickle.dumps(data, protocol=wire.PICKLE_PROTOCOL))
+        return True
 
     def save_frames(self, frames):
         """Append a message captured as raw ZMQ frames.
@@ -79,6 +122,11 @@ class FileRecorder:
             self.save(wire.decode_raw_frames(frames), is_pickled=False)
 
     def __exit__(self, *args):
+        # flush buffered records BEFORE the in-place header rewrite:
+        # BufferedWriter.seek would flush implicitly, but the invariant
+        # (every record byte lands before any header byte is replaced)
+        # is load-bearing for crash forensics, so it is explicit
+        self.file.flush()
         self.file.seek(0)
         self._write_header()  # fixed byte length: same capacity, same protocol
         self.file.close()

@@ -269,6 +269,26 @@ def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None,
                 )
                 if transform is not None:
                     host_batch = transform(host_batch)
+                if isinstance(batch, ArenaBatch) and \
+                        jax.default_backend() == "cpu":
+                    # CPU jax's device_put zero-copies aligned numpy
+                    # arrays (may_alias=False included): the jax.Array
+                    # ALIASES the arena buffer, so recycling below would
+                    # let the next batch's scatter mutate an already-
+                    # yielded "device" batch in place.  Host-copy the
+                    # leaves still backed by arena memory (a copying
+                    # transform's outputs already own theirs); real
+                    # accelerators skip all of it — their H2D DMA is the
+                    # copy, fenced by block_until_ready before recycle.
+                    arena_bufs = tuple(batch.arena.buffers.values())
+
+                    def _own(x, _bufs=arena_bufs):
+                        arr = np.asarray(x)
+                        if any(np.may_share_memory(arr, b) for b in _bufs):
+                            return np.array(arr)
+                        return x
+
+                    host_batch = jax.tree.map(_own, host_batch)
                 with timer.stage("device_put"):
                     if gate is not None:
                         with gate.transfer():

@@ -37,7 +37,7 @@ import threading
 import time
 
 from blendjax.btt.watchdog import FleetWatchdog
-from blendjax.utils.timing import FLEET_EVENTS, fleet_counters
+from blendjax.utils.timing import FLEET_EVENTS, REPLAY_EVENTS, fleet_counters
 
 logger = logging.getLogger("blendjax")
 
@@ -68,6 +68,12 @@ class FleetSupervisor:
     heal_interval: float
         Heal-thread cadence, seconds (each tick drives pending
         re-admission probes).
+    replay: blendjax.replay.ReplayBuffer | None
+        When the training loop runs off-policy, attach its buffer (here
+        or via :meth:`attach_replay`) so :meth:`health` reports the
+        replay fill/exclusion state and stage timings alongside the
+        fleet counters — one snapshot for the whole acting+learning
+        story.
     """
 
     def __init__(
@@ -79,6 +85,7 @@ class FleetSupervisor:
         counters=None,
         on_death=None,
         heal_interval=0.05,
+        replay=None,
     ):
         self.launcher = launcher
         self.pool = pool
@@ -90,6 +97,7 @@ class FleetSupervisor:
             launcher, interval=interval, on_death=self._on_death,
             restart=restart,
         )
+        self.replay = replay
         self.heal_interval = heal_interval
         self._stop = threading.Event()
         self._event = threading.Event()  # pulses on any state change
@@ -171,6 +179,12 @@ class FleetSupervisor:
 
     # -- stream verification --------------------------------------------------
 
+    def attach_replay(self, buffer):
+        """Report ``buffer`` (a :class:`blendjax.replay.ReplayBuffer`)
+        in :meth:`health` snapshots — same effect as the constructor's
+        ``replay=``, for buffers created after the supervisor."""
+        self.replay = buffer
+
     def add_health_check(self, name, fn):
         """Register ``fn() -> bool`` evaluated by :meth:`health` and
         required by :meth:`await_healthy` — e.g. a dataset-stream remap
@@ -182,9 +196,10 @@ class FleetSupervisor:
 
     def health(self):
         """One snapshot of fleet health: every canonical fault counter
-        (zero-filled, see ``FLEET_EVENTS``), watchdog liveness, the
-        pool's quarantine state, and registered stream checks."""
-        h = dict.fromkeys(FLEET_EVENTS, 0)
+        (zero-filled, see ``FLEET_EVENTS``/``REPLAY_EVENTS``), watchdog
+        liveness, the pool's quarantine state, the attached replay
+        buffer's fill/exclusion stats, and registered stream checks."""
+        h = dict.fromkeys(FLEET_EVENTS + REPLAY_EVENTS, 0)
         h.update(self.counters.snapshot())
         h["alive"] = self.watchdog.alive
         if self.pool is not None:
@@ -200,6 +215,8 @@ class FleetSupervisor:
                 h["pipeline_depth"] = int(
                     getattr(self.pool, "pipeline_depth", 1)
                 )
+        if self.replay is not None:
+            h["replay"] = self.replay.stats()
         h["checks"] = {name: bool(fn()) for name, fn in self._checks.items()}
         return h
 

@@ -60,11 +60,25 @@ class ActorLearner:
         previous segment (the ``np.stack`` + queue handoff — including
         any block on a full queue — happens inside the simulation
         window).  False keeps the lock-step ``pool.step`` loop.
+    replay: blendjax.replay.ReplayBuffer | None
+        Off-policy path (docs/replay.md): the actor thread appends every
+        transition — quarantine-aware, so a degraded rollout's synthetic
+        transitions land flagged and are never sampled — and the learner
+        follows each on-policy update with ``replay_ratio`` sampled
+        off-policy updates (importance-weighted single-step policy
+        gradient, priorities refreshed from |advantage|).  A prefilled
+        buffer also trains with no fleet at all via :meth:`run_offline`.
+    replay_ratio: int
+        Off-policy updates per on-policy update (0 = append-only: the
+        buffer fills for later offline runs/checkpoints).
+    replay_batch: int
+        Transitions per off-policy update.
     """
 
     def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
                  queue_size=4, optimizer=None, gamma=0.99, seed=0,
-                 continuous=False, action_map=None, pipeline=False):
+                 continuous=False, action_map=None, pipeline=False,
+                 replay=None, replay_ratio=0, replay_batch=64):
         self.pool = pool
         self.rollout_len = rollout_len
         self.gamma = gamma
@@ -109,6 +123,37 @@ class ActorLearner:
         # snapshot that must survive the next update; donating the state
         # would invalidate the snapshot's buffers under the actor's feet
         self._step = make_train_step(loss_fn, self.opt, donate=False)
+
+        self.replay = replay
+        self.replay_ratio = int(replay_ratio)
+        self.replay_batch = int(replay_batch)
+        if replay_ratio and replay is None:
+            raise ValueError("replay_ratio > 0 requires a replay buffer")
+
+        def replay_loss_fn(p, batch):
+            # importance-weighted single-step policy gradient over
+            # sampled transitions: logp of the STORED action under the
+            # CURRENT policy, advantage = batch-normalized reward,
+            # weighted by the sampler's IS weights (PER bias correction)
+            if continuous:
+                logp = policy.gaussian_log_prob(
+                    p, batch["obs"], batch["action"]
+                )
+            else:
+                logp = policy.categorical_log_prob(
+                    p, batch["obs"], batch["action"]
+                )
+            r = batch["reward"]
+            adv = (r - r.mean()) / (r.std() + 1e-6)
+            return -jnp.mean(
+                batch["is_weight"] * logp * jax.lax.stop_gradient(adv)
+            )
+
+        self._replay_step = (
+            make_train_step(replay_loss_fn, self.opt, donate=False)
+            if replay is not None
+            else None
+        )
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
         self._thread = None
@@ -191,9 +236,29 @@ class ActorLearner:
                     seg_act.append(action)
                     seg_rew.append(np.asarray(rew, np.float32))
                     seg_done.append(np.asarray(done, bool))
+                    prev_obs = obs
                     obs = np.asarray(nobs, np.float32)
                     if obs.ndim == 1:
                         obs = obs[:, None]
+                    if self.replay is not None:
+                        # quarantine-aware appends: a synthetic transition
+                        # from a quarantined slot is stored flagged and
+                        # never sampled (docs/replay.md)
+                        self.replay.extend(
+                            (
+                                {
+                                    "obs": prev_obs[i],
+                                    "action": action[i],
+                                    "reward": seg_rew[-1][i],
+                                    "next_obs": obs[i],
+                                    "done": seg_done[-1][i],
+                                }
+                                for i in range(self.pool.num_envs)
+                            ),
+                            healthy=[
+                                inf.get("healthy", True) for inf in infos
+                            ],
+                        )
                     self._env_steps += self.pool.num_envs
                 seg_lists = (seg_obs, seg_act, seg_rew, seg_done)
                 if self.pipeline:
@@ -208,6 +273,105 @@ class ActorLearner:
 
     # -- learner side ------------------------------------------------------
 
+    def _replay_step_and_refresh(self, batch, idx, reward):
+        """The shared off-policy post-draw block (online tail AND
+        run_offline): one sampled update, actor params mirror, and the
+        sampled rows' priorities refreshed from |advantage| under the
+        batch baseline (the same signal the loss weights)."""
+        self.state, loss = self._replay_step(self.state, batch)
+        self._actor_params = self.state.params
+        r = np.asarray(reward, np.float64)
+        self.replay.update_priorities(idx, np.abs(r - r.mean()))
+        return float(loss)
+
+    def _replay_update(self, data, idx, weights):
+        """One off-policy update from a host-side sampled batch."""
+        batch = jax.device_put(
+            {
+                "obs": data["obs"],
+                "action": data["action"],
+                "reward": data["reward"],
+                "is_weight": weights,
+            }
+        )
+        return self._replay_step_and_refresh(batch, idx, data["reward"])
+
+    def _drain_replay_ratio(self, replay_losses):
+        """The learner's off-policy tail: up to ``replay_ratio`` sampled
+        updates, skipped (not blocked on) while the buffer is short —
+        early in training the on-policy path must keep moving.
+        ``timeout=0`` makes the shortfall check and the draw one atomic
+        step (a pre-check of ``num_eligible`` could pass and then a
+        degraded fleet's unhealthy appends evict the eligible rows
+        before the draw acquired the lock, blocking the learner)."""
+        for _ in range(self.replay_ratio):
+            try:
+                data, idx, w = self.replay.sample(
+                    self.replay_batch, timeout=0.0,
+                    keys=("obs", "action", "reward"),
+                )
+            except TimeoutError:
+                return
+            replay_losses.append(self._replay_update(data, idx, w))
+
+    def run_offline(self, num_updates, batch_size=64, *, arena_pool=None,
+                    prefetch=2):
+        """Train purely from the replay buffer — zero Blender processes
+        (e.g. after :func:`blendjax.replay.prefill_from_btr`).
+
+        Sampled batches are gathered straight into recycled
+        :class:`~blendjax.btt.arena.ArenaPool` buffers and staged onto
+        the device through ``device_prefetch`` — the PR-1 feed seam,
+        driven by the sampler instead of the wire; sampling for batch
+        t+1 overlaps the update on batch t.  Returns a stats dict.
+        """
+        from blendjax.btt.arena import ArenaPool
+        from blendjax.btt.prefetch import device_prefetch
+
+        if self.replay is None:
+            raise RuntimeError("run_offline requires a replay buffer")
+        pool = arena_pool or ArenaPool(pool_size=prefetch + 2)
+        stop = threading.Event()
+        gen = self.replay.sample_batches(
+            batch_size, arena_pool=pool, stop_event=stop,
+            # gather (and transfer) only what the off-policy loss and
+            # the priority refresh read — next_obs/done alone would
+            # double the per-batch copy volume for image observations
+            keys=("obs", "action", "reward"),
+        )
+        losses = []
+        t0 = time.perf_counter()
+        it = device_prefetch(
+            gen, size=prefetch, timer=self.replay.timer
+        )
+        try:
+            for dev_batch in it:
+                # sidecar meta came back in-band (the prefetcher unwraps
+                # ArenaBatch), keying the priority refresh
+                losses.append(self._replay_step_and_refresh(
+                    {
+                        "obs": dev_batch["obs"],
+                        "action": dev_batch["action"],
+                        "reward": dev_batch["reward"],
+                        "is_weight": dev_batch["is_weight"],
+                    },
+                    np.asarray(dev_batch["replay_idx"]),
+                    np.asarray(dev_batch["reward"]),
+                ))
+                if len(losses) >= num_updates:
+                    break
+        finally:
+            stop.set()
+            it.close()
+        elapsed = time.perf_counter() - t0
+        return {
+            "updates": len(losses),
+            "updates_per_sec": round(len(losses) / elapsed, 2),
+            "losses": losses,
+            "replay": self.replay.stats(),
+            "elapsed_s": round(elapsed, 3),
+        }
+
     def run(self, num_updates=None, seconds=None):
         """Run the overlapped loop for ``num_updates`` learner steps OR a
         ``seconds`` wall-clock budget (whichever is given; both = either
@@ -217,6 +381,13 @@ class ActorLearner:
         counter, and an emptied queue (a previous run's buffered segments
         carry a stale policy and would also corrupt the throughput math).
         """
+        if self.pool is None:
+            # constructible fleet-less for the pure off-policy path
+            # (prefilled replay buffer): that path is run_offline()
+            raise RuntimeError(
+                "no EnvPool attached; use run_offline() to train from "
+                "the replay buffer"
+            )
         if num_updates is None and seconds is None:
             raise ValueError("pass num_updates and/or seconds")
         if self._thread is not None and self._thread.is_alive():
@@ -243,7 +414,7 @@ class ActorLearner:
         t0 = time.perf_counter()
         deadline = t0 + seconds if seconds is not None else None
         self._thread.start()
-        losses, seg_rewards = [], []
+        losses, seg_rewards, replay_losses = [], [], []
         try:
             while True:
                 if num_updates is not None and len(losses) >= num_updates:
@@ -273,11 +444,13 @@ class ActorLearner:
                 self._actor_params = self.state.params
                 losses.append(float(loss))
                 seg_rewards.append(float(seg[2].mean()))
+                if self.replay is not None and self.replay_ratio > 0:
+                    self._drain_replay_ratio(replay_losses)
         finally:
             self._stop.set()
             self._thread.join(timeout=10)
         elapsed = time.perf_counter() - t0
-        return {
+        stats = {
             "updates": len(losses),
             "env_steps": self._env_steps,
             "unhealthy_env_steps": self._unhealthy_env_steps,
@@ -289,3 +462,8 @@ class ActorLearner:
             "losses": losses,
             "elapsed_s": round(elapsed, 3),
         }
+        if self.replay is not None:
+            stats["replay_updates"] = len(replay_losses)
+            stats["replay_losses"] = replay_losses
+            stats["replay"] = self.replay.stats()
+        return stats

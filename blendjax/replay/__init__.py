@@ -1,0 +1,41 @@
+"""Experience replay subsystem: columnar ring storage, prioritized
+sampling, ``.btr`` spill/prefill, and the off-policy learner seam.
+
+Opens the off-policy workload family (docs/replay.md): the PR-4
+pipelined actor appends transitions while the learner samples batches —
+through :class:`~blendjax.btt.arena.ArenaPool` + ``device_prefetch`` on
+the device path — and recorded ``.btr`` logs hydrate the buffer so
+training runs with zero Blender processes.
+
+Public surface::
+
+    from blendjax.replay import ReplayBuffer, prefill_from_btr
+
+    buf = ReplayBuffer(100_000, seed=0, prioritized=True)
+    buf.append({"obs": o, "action": a, "reward": r,
+                "next_obs": o2, "done": d}, healthy=True)
+    data, idx, w = buf.sample(32)
+    buf.update_priorities(idx, errors)
+    buf.save("replay.npz"); buf = ReplayBuffer.restore("replay.npz")
+"""
+
+from blendjax.replay.buffer import HEALTHY_KEY, ReplayBuffer
+from blendjax.replay.prefill import (
+    iter_btr_transitions,
+    message_to_transition,
+    prefill_from_btr,
+    transition_to_message,
+)
+from blendjax.replay.ring import ColumnStore
+from blendjax.replay.sumtree import SumTree
+
+__all__ = [
+    "HEALTHY_KEY",
+    "ReplayBuffer",
+    "ColumnStore",
+    "SumTree",
+    "prefill_from_btr",
+    "iter_btr_transitions",
+    "transition_to_message",
+    "message_to_transition",
+]

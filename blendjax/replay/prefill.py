@@ -1,0 +1,113 @@
+"""``.btr`` <-> replay interop: hydrate a :class:`ReplayBuffer` from
+recorded transition logs so off-policy training runs with ZERO Blender
+processes.
+
+The framework's record/replay format (:mod:`blendjax.btt.file`, the
+reference's checkpoint/resume analog) already persists raw message
+dicts; a *transition* message is simply the transition dict itself plus
+the quarantine flag (``healthy``) riding in-band — pickled numpy arrays
+round-trip exactly, so a buffer prefilled from a recording is
+bit-identical to one fed the same transitions by direct appends (locked
+by ``tests/test_replay.py``).
+
+Workflow::
+
+    # live run, recording (fleet side):
+    rec = FileRecorder("run_00.btr", max_messages=100000)
+    with rec:
+        for step in range(n):
+            obs2, rew, done, infos = pool.step(actions)
+            for i in range(pool.num_envs):
+                rec.save(transition_to_message(
+                    {"obs": obs[i], "action": actions[i],
+                     "reward": rew[i], "next_obs": obs2[i],
+                     "done": done[i]},
+                    healthy=infos[i].get("healthy", True)))
+
+    # later, no Blender anywhere:
+    buf = ReplayBuffer(200000, seed=0)
+    n = prefill_from_btr(buf, "run")          # every run_*.btr
+    learner.run_offline(num_updates=..., batch_size=...)
+"""
+
+from __future__ import annotations
+
+from glob import glob
+from pathlib import Path
+
+from blendjax.btt.file import FileReader
+from blendjax.replay.buffer import HEALTHY_KEY
+
+
+def transition_to_message(transition, *, healthy=True):
+    """Transition dict -> recordable message: the dict itself with the
+    quarantine flag in-band under :data:`HEALTHY_KEY`."""
+    msg = dict(transition)
+    msg[HEALTHY_KEY] = bool(
+        msg.get(HEALTHY_KEY, True)
+    ) and bool(healthy)
+    return msg
+
+
+def message_to_transition(message):
+    """Recorded message -> ``(transition, healthy)``; the inverse of
+    :func:`transition_to_message` (flag stripped from the dict)."""
+    transition = dict(message)
+    healthy = bool(transition.pop(HEALTHY_KEY, True))
+    return transition, healthy
+
+
+def iter_btr_transitions(prefix_or_paths):
+    """Yield ``(transition, healthy)`` from ``.btr`` recordings.
+
+    ``prefix_or_paths``: an explicit path / list of paths, or a prefix
+    matching ``{prefix}_*.btr`` (the ``FileRecorder.filename`` per-worker
+    scheme) — files are visited in sorted order so the append sequence
+    is deterministic.
+    """
+    if isinstance(prefix_or_paths, (str, Path)):
+        p = Path(prefix_or_paths)
+        if p.exists():
+            paths = [p]
+        else:
+            paths = sorted(glob(f"{prefix_or_paths}_*.btr"))
+            if not paths:
+                raise FileNotFoundError(
+                    f"no .btr file or recordings matching "
+                    f"{prefix_or_paths}_*.btr"
+                )
+    else:
+        paths = list(prefix_or_paths)
+    for path in paths:
+        reader = FileReader(path)
+        try:
+            for i in range(len(reader)):
+                yield message_to_transition(reader[i])
+        finally:
+            reader.close()
+
+
+def prefill_from_btr(buffer, prefix_or_paths, *, transform=None, limit=None):
+    """Hydrate ``buffer`` from recorded transition logs; returns the
+    number of transitions appended.
+
+    ``transform`` (optional) maps each raw message dict to a transition
+    dict — use it to adapt recordings whose messages are NOT already
+    transition-shaped (e.g. a datagen stream's ``{"image", "xy", ...}``
+    frames, or to drop wire bookkeeping keys like ``btid``).  The
+    quarantine flag is honored either way: an unhealthy recorded
+    transition lands excluded from sampling, exactly as a live
+    quarantine-aware append would.  ``limit`` caps the appends (the ring
+    evicts oldest-first beyond capacity regardless).
+    """
+    appended = 0
+    for transition, healthy in iter_btr_transitions(prefix_or_paths):
+        if limit is not None and appended >= limit:
+            break
+        if transform is not None:
+            transition = transform(transition)
+            if transition is None:
+                continue
+        buffer.append(transition, healthy=healthy)
+        appended += 1
+    return appended

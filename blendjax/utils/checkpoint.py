@@ -58,6 +58,41 @@ def load_pytree(path, target):
     return jax.tree_util.tree_unflatten(treedef, loaded)
 
 
+def save_state(path, arrays, meta=None):
+    """Serialize named arrays + a JSON-able metadata dict to ``path``
+    (.npz, atomic rename) — the sibling of :func:`save_pytree` for
+    states that are NOT fixed-structure pytrees (e.g. a replay buffer's
+    columns + ring indices + RNG state, whose keys vary per schema).
+
+    ``meta`` may hold anything ``json.dumps`` accepts — Python ints of
+    any size round-trip exactly, so numpy bit-generator states (128-bit
+    ints) are safe.
+    """
+    import json
+
+    if "__meta__" in arrays:
+        raise ValueError("'__meta__' is reserved for the metadata channel")
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), np.uint8
+    )
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+
+
+def load_state(path):
+    """Restore ``(arrays, meta)`` written by :func:`save_state`."""
+    import json
+
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files if k != "__meta__"}
+        meta = json.loads(bytes(data["__meta__"]).decode()) \
+            if "__meta__" in data.files else {}
+    return arrays, meta
+
+
 def save_train_state(path, state):
     """Persist a :class:`blendjax.models.train.TrainState`."""
     save_pytree(path, state)

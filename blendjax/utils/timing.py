@@ -58,6 +58,38 @@ FLEET_EVENTS = (
     # pipeline flush); a reply lost ahead of an out-of-order match is NOT
     # discarded — it is re-sent and answered from the producer reply cache
     "ready_waits", "stale_replies", "inflight_discards",
+    # record path: ``record_drops`` — messages FileRecorder refused because
+    # its fixed capacity was reached (the recording is truncated, not the
+    # stream; see btt/file.py)
+    "record_drops",
+)
+
+#: Canonical experience-replay event names (see docs/replay.md).  Same
+#: contract as ``FLEET_EVENTS``: any ``EventCounters`` instance accepts
+#: them, and ``FleetSupervisor.health()`` zero-fills every name so
+#: dashboards need no existence checks.
+#: ``replay_appends`` — transitions accepted into the ring;
+#: ``replay_overwrites`` — appends that evicted a live transition (ring
+#: wraparound: the buffer is at capacity and recycling oldest-first);
+#: ``replay_excluded`` — appends flagged unhealthy (synthetic
+#: degraded-mode transitions: stored for inspection, never sampled);
+#: ``replay_samples`` — batches drawn;
+#: ``replay_sample_waits`` — sample calls that blocked on an
+#: underfilled buffer (learner outpacing the actor);
+#: ``replay_priority_updates`` — update_priorities calls applied.
+REPLAY_EVENTS = (
+    "replay_appends", "replay_overwrites", "replay_excluded",
+    "replay_samples", "replay_sample_waits", "replay_priority_updates",
+)
+
+#: Canonical replay-path stage names (see docs/replay.md), the
+#: :class:`StageTimer` vocabulary the replay benchmark and
+#: ``ReplayBuffer`` report under: ``replay_append`` (row scatter into the
+#: ring columns), ``sample_wait`` (blocked on an underfilled buffer),
+#: ``sample_gather`` (index draw + columnar gather into the batch),
+#: ``priority_update`` (sum-tree refresh after a learner step).
+REPLAY_STAGES = (
+    "replay_append", "sample_wait", "sample_gather", "priority_update",
 )
 
 

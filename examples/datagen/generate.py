@@ -146,6 +146,18 @@ def main():
             elif args.infer_int8:
                 print("int8 inference SKIPPED: stream yielded no batch")
         print("stage timing:", stream.timer.summary())
+        if args.record:
+            from blendjax.utils.timing import fleet_counters
+
+            drops = fleet_counters.get("record_drops")
+            if drops:
+                # the recorders warn once each; this is the end-of-run
+                # tally so a truncated dataset is impossible to miss
+                print(
+                    f"WARNING: recording truncated — {drops} messages "
+                    "dropped at recorder capacity (raise --items or "
+                    "FileRecorder max_messages)"
+                )
 
 
 if __name__ == "__main__":

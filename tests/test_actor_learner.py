@@ -53,6 +53,45 @@ def test_actor_learner_improves_and_overlaps(fake_blender):
     assert last > 0.08, f"policy failed to converge: {last}"
 
 
+def test_actor_learner_with_replay_off_policy_path(fake_blender):
+    """replay= wires the off-policy path: the actor appends every
+    transition (quarantine-aware), the learner follows each on-policy
+    update with replay_ratio sampled updates, and the filled buffer then
+    drives run_offline with the fleet gone (zero Blender processes)."""
+    from blendjax.replay import ReplayBuffer
+
+    values = np.array([0.0, 1.0], np.float64)
+    buf = ReplayBuffer(4096, seed=0)
+    with launch_env_pool(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        background=True,
+        horizon=1_000_000,
+        timeoutms=30000,
+        start_port=14850,
+    ) as pool:
+        al = ActorLearner(
+            pool, obs_dim=1, num_actions=2, rollout_len=16,
+            seed=1, action_map=lambda a: list(values[np.asarray(a)]),
+            replay=buf, replay_ratio=1, replay_batch=32,
+        )
+        stats = al.run(num_updates=20)
+
+    assert stats["updates"] == 20
+    # the actor really appended: one transition per env step
+    assert stats["replay"]["appends"] == stats["env_steps"]
+    assert stats["replay"]["excluded"] == 0  # clean run: nothing flagged
+    assert stats["replay_updates"] > 0
+    assert len(buf) > 0
+
+    # the fleet is gone now — off-policy training continues from the
+    # buffer alone (the .btr-prefill workflow's learner half)
+    off = al.run_offline(num_updates=10, batch_size=32)
+    assert off["updates"] == 10
+    assert off["replay"]["samples"] >= 10
+
+
 def test_actor_learner_pipelined_double_buffer(fake_blender):
     """pipeline=True routes rollout collection through the pool's async
     step_async/step_wait path (envs simulate t+1 while the actor

@@ -45,6 +45,55 @@ def test_capacity_limit(tmp_path):
     assert len(FileReader(path)) == 3
 
 
+def test_capacity_drop_warns_once_counts_and_returns_false(tmp_path, caplog):
+    """Regression for the silent-drop behavior: at capacity the recorder
+    must warn (once), count every drop, emit ``record_drops`` events,
+    and report the drop through ``save``'s return value."""
+    import logging
+
+    from blendjax.utils.timing import EventCounters
+
+    counters = EventCounters()
+    path = tmp_path / "drop.btr"
+    with caplog.at_level(logging.WARNING, logger="blendjax"):
+        with FileRecorder(path, max_messages=3, counters=counters) as rec:
+            results = [rec.save(m) for m in _messages(10)]
+            assert rec.dropped == 7
+    assert results == [True] * 3 + [False] * 7
+    assert counters.get("record_drops") == 7
+    warnings = [
+        r for r in caplog.records if "DROPPED" in r.getMessage()
+    ]
+    assert len(warnings) == 1  # once per recorder, not per message
+    assert len(FileReader(path)) == 3
+
+
+def test_buffered_writes_flush_before_header_rewrite(tmp_path):
+    """The default is now buffered (the reference's ``buffering=0`` was
+    one syscall per record): records must be fully flushed before the
+    in-place header rewrite, and ``buffering=0`` must stay available and
+    byte-compatible."""
+    msgs = _messages(6)
+    paths = {}
+    for label, kwargs in (
+        ("buffered", {}),
+        ("unbuffered", {"buffering": 0}),
+    ):
+        path = tmp_path / f"{label}.btr"
+        with FileRecorder(path, max_messages=8, **kwargs) as rec:
+            assert rec.file.tell() > 0  # header written (logical position)
+            for m in msgs:
+                rec.save(m)
+        paths[label] = path
+        reader = FileReader(path)
+        assert len(reader) == 6
+        for i, m in enumerate(msgs):
+            np.testing.assert_array_equal(reader[i]["image"], m["image"])
+        reader.close()
+    # identical bytes: buffering is an I/O strategy, not a format change
+    assert paths["buffered"].read_bytes() == paths["unbuffered"].read_bytes()
+
+
 def test_prepickled_and_frames(tmp_path):
     path = tmp_path / "pp.btr"
     from blendjax import wire

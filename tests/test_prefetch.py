@@ -47,6 +47,32 @@ def test_device_prefetch_error_propagates():
         next(it)
 
 
+def test_device_prefetch_arena_batch_does_not_alias_recycled_arena():
+    """CPU jax's device_put zero-copies aligned numpy arrays; without
+    the host-copy guard, recycling an ArenaBatch after transfer lets the
+    NEXT batch's gather rewrite an already-yielded device batch in place
+    (caught live as a replay sample stream whose obs desynced from its
+    sidecar indices)."""
+    from blendjax.btt.arena import ArenaBatch, ArenaPool
+
+    pool = ArenaPool(pool_size=1)  # one arena: every batch reuses it
+
+    def batches():
+        for i in range(4):
+            arena = pool.acquire(timeout=5.0)
+            buf = arena.get_buffer("x", (8, 4), np.float32)
+            buf[:] = i
+            yield ArenaBatch({"x": buf}, arena)
+
+    out = []
+    for b in device_prefetch(batches(), size=2):
+        out.append(b)
+    assert len(out) == 4
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b["x"]), np.full((8, 4), i))
+    assert pool.in_use == 0
+
+
 def test_put_batch_sharded_over_mesh():
     assert jax.device_count() == 8, "conftest must force 8 virtual devices"
     mesh = data_mesh()

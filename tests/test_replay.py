@@ -1,0 +1,648 @@
+"""Experience replay subsystem tests: columnar ring semantics, sum-tree
+prioritization, seeded sampling determinism (incl. across a checkpoint
+round-trip and under concurrent actor appends), quarantine exclusion,
+``.btr`` prefill parity, the arena + device_prefetch drain, and the
+replay benchmark's result schema."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.replay import (
+    HEALTHY_KEY,
+    ColumnStore,
+    ReplayBuffer,
+    SumTree,
+    message_to_transition,
+    prefill_from_btr,
+    transition_to_message,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ENV_SCRIPT = os.path.join(HERE, "blender", "env.blend.py")
+
+
+def _tr(k, obs_dim=4):
+    """Deterministic transition whose every field encodes ``k`` — a
+    sampled row with disagreeing fields is a torn row."""
+    return {
+        "obs": np.full((obs_dim,), k, np.float32),
+        "action": np.int32(k % 7),
+        "reward": np.float32(k),
+        "done": bool(k % 5 == 0),
+    }
+
+
+def _fill(buf, n, healthy=None, start=0):
+    for k in range(start, start + n):
+        buf.append(_tr(k), healthy=True if healthy is None else healthy(k))
+
+
+# -- sum tree ----------------------------------------------------------------
+
+
+def test_sumtree_set_total_search():
+    t = SumTree(8)
+    t.set(0, 1.0)
+    t.set(3, 3.0)
+    t.set(7, 4.0)
+    assert t.total == pytest.approx(8.0)
+    assert t.prefix_search(0.5) == 0
+    assert t.prefix_search(1.5) == 3
+    assert t.prefix_search(7.9) == 7
+    t.set(3, 0.0)
+    assert t.total == pytest.approx(5.0)
+    assert t.get(3) == 0.0
+
+
+def test_sumtree_rebuild_matches_incremental():
+    # both power-of-two and ragged capacities (leaves at mixed depths)
+    for cap in (16, 13, 3, 2, 1):
+        leaves = np.arange(cap, dtype=float) + 0.5
+        a, b = SumTree(cap), SumTree(cap)
+        for i, p in enumerate(leaves):
+            a.set(i, p)
+        b.rebuild(leaves)
+        np.testing.assert_array_equal(a._tree, b._tree)
+        for m in np.linspace(0.0, a.total, 7, endpoint=False):
+            assert a.prefix_search(float(m)) == b.prefix_search(float(m))
+
+
+def test_sumtree_batch_search_matches_scalar():
+    """The vectorized level-synchronous descent must be bit-identical to
+    the scalar walk — the sampler's draw stream depends on it — incl. a
+    non-power-of-two capacity where leaves sit at mixed depths."""
+    rng = np.random.default_rng(3)
+    for cap in (16, 13):
+        t = SumTree(cap)
+        for i, p in enumerate(rng.random(cap) * 5):
+            t.set(i, float(p))
+        masses = rng.random(64) * t.total
+        batch = t.prefix_search_batch(masses)
+        scalar = [t.prefix_search(float(m)) for m in masses]
+        np.testing.assert_array_equal(batch, scalar)
+        np.testing.assert_array_equal(
+            t.get_many(batch), [t.get(i) for i in batch]
+        )
+
+
+def test_sumtree_rejects_bad_priorities():
+    t = SumTree(4)
+    with pytest.raises(ValueError):
+        t.set(0, -1.0)
+    with pytest.raises(ValueError):
+        t.set(0, float("nan"))
+    with pytest.raises(ValueError):
+        t.rebuild([1.0, -1.0, 0.0, 0.0])
+
+
+# -- columnar ring store -----------------------------------------------------
+
+
+def test_columnstore_schema_fixed_and_drift_raises():
+    cs = ColumnStore(4)
+    cs.write_row(0, _tr(0))
+    assert set(cs.keys) == {"obs", "action", "reward", "done"}
+    with pytest.raises(ValueError):
+        cs.write_row(1, {**_tr(1), "obs": np.zeros((5,), np.float32)})
+    with pytest.raises(KeyError):
+        cs.write_row(1, {"obs": np.zeros((4,), np.float32)})
+    with pytest.raises(TypeError):
+        ColumnStore(4).write_row(0, {"s": "a string"})
+
+
+def test_columnstore_rejecting_first_row_leaves_no_partial_schema():
+    """A rejected first append must not leak half-allocated columns
+    into a retried append's (different) schema."""
+    cs = ColumnStore(4)
+    with pytest.raises(TypeError):
+        cs.write_row(0, {"obs": np.zeros(4, np.float32), "note": "str"})
+    assert cs.keys == ()
+    cs.write_row(0, _tr(0))
+    assert set(cs.keys) == {"obs", "action", "reward", "done"}
+    assert set(cs.gather([0])) == {"obs", "action", "reward", "done"}
+
+
+def test_columnstore_gather_keys_selection():
+    cs = ColumnStore(4)
+    cs.write_row(0, _tr(5))
+    batch = cs.gather([0, 0], keys=("obs", "reward"))
+    assert set(batch) == {"obs", "reward"}
+    np.testing.assert_array_equal(batch["reward"], [5.0, 5.0])
+    with pytest.raises(KeyError, match="no such replay column"):
+        cs.gather([0], keys=("nope",))
+
+
+def test_columnstore_read_row_copies():
+    cs = ColumnStore(4)
+    cs.write_row(0, _tr(3))
+    row = cs.read_row(0)
+    row["obs"][:] = -1
+    np.testing.assert_array_equal(cs.read_row(0)["obs"], np.full(4, 3, np.float32))
+
+
+def test_columnstore_gather_out_and_alloc():
+    cs = ColumnStore(8)
+    for k in range(8):
+        cs.write_row(k, _tr(k))
+    idx = np.array([7, 0, 3, 3])
+    batch = cs.gather(idx)
+    np.testing.assert_array_equal(batch["reward"], [7, 0, 3, 3])
+    np.testing.assert_array_equal(batch["obs"][1], np.zeros(4, np.float32))
+    # preallocated destinations (dict form) are written in place
+    out = {"obs": np.empty((4, 4), np.float32)}
+    batch2 = cs.gather(idx, out=out)
+    assert batch2["obs"] is out["obs"]
+    np.testing.assert_array_equal(batch2["obs"], batch["obs"])
+    # callable form (the Arena.get_buffer signature)
+    made = {}
+
+    def factory(key, shape, dtype):
+        made[key] = np.empty(shape, dtype)
+        return made[key]
+
+    batch3 = cs.gather(idx, out=factory)
+    assert batch3["reward"] is made["reward"]
+    np.testing.assert_array_equal(batch3["reward"], batch["reward"])
+
+
+# -- replay buffer -----------------------------------------------------------
+
+
+def test_ring_wraparound_and_counts():
+    from blendjax.utils.timing import EventCounters
+
+    counters = EventCounters()
+    buf = ReplayBuffer(8, seed=0, counters=counters)
+    _fill(buf, 20)
+    assert len(buf) == 8
+    assert buf.num_eligible == 8
+    stats = buf.stats()
+    assert stats["appends"] == 20
+    assert stats["overwrites"] == 12
+    assert counters.get("replay_appends") == 20
+    assert counters.get("replay_overwrites") == 12
+    # ring holds the LAST 8 transitions (12..19)
+    rewards = sorted(float(buf.get(i)["reward"]) for i in range(8))
+    assert rewards == [float(k) for k in range(12, 20)]
+
+
+def test_unhealthy_rows_stored_but_never_sampled():
+    buf = ReplayBuffer(64, seed=1)
+    _fill(buf, 48, healthy=lambda k: k % 3 != 0)
+    assert len(buf) == 48
+    assert buf.num_eligible == 32
+    assert buf.stats()["excluded"] == 16
+    seen = set()
+    for _ in range(40):
+        _, idx, _ = buf.sample(16)
+        seen.update(int(i) for i in idx)
+    sampled_rewards = {int(buf.get(i)["reward"]) for i in seen}
+    assert all(k % 3 != 0 for k in sampled_rewards)
+    # uniform mode applies the same mask
+    ubuf = ReplayBuffer(64, seed=1, prioritized=False)
+    _fill(ubuf, 48, healthy=lambda k: k % 3 != 0)
+    for _ in range(20):
+        data, idx, w = ubuf.sample(16)
+        assert (np.asarray(data["reward"]).astype(int) % 3 != 0).all()
+        np.testing.assert_array_equal(w, np.ones(16, np.float32))
+
+
+def test_healthy_flag_rides_in_band():
+    buf = ReplayBuffer(8, seed=0)
+    buf.append({**_tr(1), HEALTHY_KEY: False})
+    buf.append({**_tr(2), HEALTHY_KEY: True})
+    assert HEALTHY_KEY not in buf.store.keys
+    assert len(buf) == 2 and buf.num_eligible == 1
+
+
+def test_prioritized_sampling_prefers_high_priority():
+    buf = ReplayBuffer(64, seed=7, alpha=1.0)
+    _fill(buf, 64)
+    # crank one row's priority far above the rest
+    buf.update_priorities([5], [1000.0])
+    counts = np.zeros(64, int)
+    for _ in range(64):
+        _, idx, w = buf.sample(8)
+        for i in idx:
+            counts[int(i)] += 1
+        # IS weights: normalized to max 1, the over-sampled row weighted least
+        assert w.max() == pytest.approx(1.0)
+        assert w.min() > 0
+    assert counts[5] > counts.sum() // 2  # the hot row dominates the draw
+
+
+def test_sampling_determinism_same_seed_same_stream():
+    streams = []
+    for _ in range(2):
+        buf = ReplayBuffer(32, seed=123)
+        _fill(buf, 40, healthy=lambda k: k % 4 != 1)
+        buf.update_priorities([1, 2, 3], [5.0, 0.5, 2.0])
+        draws = []
+        for _ in range(6):
+            data, idx, w = buf.sample(8)
+            draws.append((idx.copy(), w.copy(), data["obs"].copy()))
+        streams.append(draws)
+    for (ia, wa, oa), (ib, wb, ob) in zip(*streams):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(wa, wb)
+        np.testing.assert_array_equal(oa, ob)
+
+
+def test_determinism_across_save_restore_roundtrip(tmp_path):
+    path = str(tmp_path / "replay.npz")
+    buf = ReplayBuffer(32, seed=9)
+    _fill(buf, 40, healthy=lambda k: k % 6 != 2)
+    buf.sample(8)  # advance the RNG mid-stream
+    buf.update_priorities([0, 4], [3.0, 7.0])
+    buf.save(path)
+    restored = ReplayBuffer.restore(path)
+    # identical contents...
+    assert restored.store.keys == buf.store.keys
+    for key in buf.store.keys:
+        np.testing.assert_array_equal(
+            restored.store.columns[key], buf.store.columns[key]
+        )
+    np.testing.assert_array_equal(restored.tree.leaves(), buf.tree.leaves())
+    assert len(restored) == len(buf)
+    assert restored.num_eligible == buf.num_eligible
+    # ...and the exact continued sample stream
+    for _ in range(5):
+        da, ia, wa = buf.sample(8)
+        db, ib, wb = restored.sample(8)
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(wa, wb)
+        np.testing.assert_array_equal(da["obs"], db["obs"])
+    # appends after restore behave identically too
+    buf.append(_tr(99))
+    restored.append(_tr(99))
+    da, ia, _ = buf.sample(4)
+    db, ib, _ = restored.sample(4)
+    np.testing.assert_array_equal(ia, ib)
+    np.testing.assert_array_equal(da["reward"], db["reward"])
+
+
+def test_restore_rejects_foreign_files(tmp_path):
+    from blendjax.utils.checkpoint import save_state
+
+    path = str(tmp_path / "other.npz")
+    save_state(path, {"x": np.zeros(3)}, {"format": "something/else"})
+    with pytest.raises(ValueError, match="not a replay checkpoint"):
+        ReplayBuffer.restore(path)
+
+
+def test_sample_wait_blocks_until_filled_and_times_out():
+    from blendjax.utils.timing import EventCounters
+
+    counters = EventCounters()
+    buf = ReplayBuffer(16, seed=0, counters=counters)
+    with pytest.raises(TimeoutError):
+        buf.sample(4, timeout=0.2)
+    assert counters.get("replay_sample_waits") >= 1
+
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.15), _fill(buf, 8)), daemon=True
+    )
+    t.start()
+    data, idx, w = buf.sample(4, timeout=10.0)
+    assert data["obs"].shape == (4, 4)
+    t.join()
+    assert buf.timer.count("sample_wait") >= 1
+    # stop_event aborts the wait with None
+    empty = ReplayBuffer(4, seed=0)
+    stop = threading.Event()
+    stop.set()
+    assert empty.sample(2, stop_event=stop, timeout=5.0) is None
+
+
+def test_concurrent_append_sample_no_torn_rows():
+    """The pipelined-actor shape: one thread appends at full rate while
+    the learner samples — every sampled row must be internally
+    consistent (all fields encode the same k), ring wraparound
+    included."""
+    buf = ReplayBuffer(64, seed=5)
+    _fill(buf, 64)
+    stop = threading.Event()
+    errors = []
+
+    def actor():
+        k = 64
+        while not stop.is_set():
+            try:
+                buf.append(_tr(k))
+            except Exception as e:  # noqa: BLE001 - surfaced by assert
+                errors.append(e)
+                return
+            k += 1
+
+    t = threading.Thread(target=actor, daemon=True)
+    t.start()
+    try:
+        for _ in range(200):
+            data, idx, w = buf.sample(8)
+            obs0 = data["obs"][:, 0]
+            np.testing.assert_array_equal(
+                data["obs"], np.repeat(obs0[:, None], 4, axis=1)
+            )
+            np.testing.assert_array_equal(data["reward"], obs0)
+            np.testing.assert_array_equal(
+                data["action"], obs0.astype(np.int64) % 7
+            )
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+    assert buf.stats()["appends"] > 64  # the actor really ran concurrently
+
+
+def test_update_priorities_skips_dead_rows():
+    buf = ReplayBuffer(8, seed=0)
+    _fill(buf, 4, healthy=lambda k: k != 2)
+    before = buf.tree.get(2)
+    assert before == 0.0
+    # establish draw generations for the live rows (3 eligible)
+    buf.sample(4, min_size=1)
+    buf.update_priorities([2, 3], [100.0, 100.0])
+    assert buf.tree.get(2) == 0.0  # excluded row stays at zero mass
+    assert buf.tree.get(3) > 0.0
+
+
+def test_update_priorities_skips_slots_overwritten_since_draw():
+    """The pipelined-actor race: a slot sampled, then wrapped past by
+    concurrent appends before the learner's priority update lands — the
+    stale magnitude must not be assigned to the slot's NEW occupant."""
+    buf = ReplayBuffer(4, seed=0)
+    _fill(buf, 4)
+    _, idx, _ = buf.sample(4)
+    # wrap the ring fully: every sampled slot now holds a new row,
+    # entered at the running max priority
+    _fill(buf, 4, start=100)
+    entered = {int(i): buf.tree.get(int(i)) for i in idx}
+    buf.update_priorities(idx, [1e6] * len(idx))
+    for i in idx:
+        assert buf.tree.get(int(i)) == entered[int(i)]  # stale update refused
+    # the new occupant keeps its entering priority until its own first
+    # draw re-arms updates (a stale update and a direct set are
+    # indistinguishable here, so both are refused)
+    buf.update_priorities([0], [5.0])
+    assert buf.tree.get(0) == entered[0]
+    _, idx2, _ = buf.sample(4)
+    buf.update_priorities(idx2, [9.0] * len(idx2))
+    assert buf.tree.get(int(idx2[0])) == pytest.approx(
+        (9.0 + buf.eps) ** buf.alpha
+    )
+
+
+# -- arena + device feed -----------------------------------------------------
+
+
+def test_sample_batches_through_arena_pool_and_device_prefetch():
+    import jax
+
+    from blendjax.btt.arena import ArenaBatch, ArenaPool
+    from blendjax.btt.prefetch import device_prefetch
+
+    buf = ReplayBuffer(64, seed=11)
+    _fill(buf, 64)
+    pool = ArenaPool(pool_size=2)
+    stop = threading.Event()
+    gen = buf.sample_batches(8, arena_pool=pool, stop_event=stop)
+    first = next(gen)
+    assert isinstance(first, ArenaBatch)
+    idx, w = first.meta
+    np.testing.assert_array_equal(first.data["replay_idx"], idx)
+    np.testing.assert_array_equal(first.data["is_weight"], w)
+    # the gathered leaves live in arena buffers (recycled batch-over-batch)
+    assert first.data["obs"] is first.arena.buffers["obs"]
+    first.recycle()
+    gen.close()
+
+    # drain through the device prefetcher: arenas recycle after transfer,
+    # sidecar indices/weights arrive in-band on the device batch
+    stop2 = threading.Event()
+    gen2 = buf.sample_batches(8, arena_pool=pool, stop_event=stop2)
+    it = device_prefetch(gen2, size=2)
+    seen = 0
+    try:
+        for dev_batch in it:
+            assert isinstance(dev_batch["obs"], jax.Array)
+            ridx = np.asarray(dev_batch["replay_idx"])
+            robs = np.asarray(dev_batch["obs"])
+            for j, slot in enumerate(ridx):
+                np.testing.assert_array_equal(
+                    robs[j], buf.get(int(slot))["obs"]
+                )
+            seen += 1
+            if seen >= 4:
+                break
+    finally:
+        stop2.set()
+        it.close()
+    assert pool.in_use == 0  # every arena returned to the freelist
+
+
+def test_sample_batches_plain_without_pool():
+    buf = ReplayBuffer(16, seed=2)
+    _fill(buf, 16)
+    gen = buf.sample_batches(4)
+    batch = next(gen)
+    assert isinstance(batch, dict) and "replay_idx" in batch
+    gen.close()
+
+
+# -- .btr prefill ------------------------------------------------------------
+
+
+def test_transition_message_roundtrip():
+    msg = transition_to_message(_tr(3), healthy=False)
+    tr, healthy = message_to_transition(msg)
+    assert healthy is False and HEALTHY_KEY not in tr
+    np.testing.assert_array_equal(tr["obs"], _tr(3)["obs"])
+
+
+def test_prefill_from_btr_bit_identical_to_direct_appends(tmp_path):
+    from blendjax.btt.file import FileRecorder
+
+    prefix = str(tmp_path / "run")
+    transitions = [(_tr(k), k % 4 != 2) for k in range(20)]
+    direct = ReplayBuffer(32, seed=21)
+    with FileRecorder(
+        FileRecorder.filename(prefix, 0), max_messages=32
+    ) as rec:
+        for tr, healthy in transitions:
+            rec.save(transition_to_message(tr, healthy=healthy))
+            direct.append(tr, healthy=healthy)
+
+    hydrated = ReplayBuffer(32, seed=21)
+    n = prefill_from_btr(hydrated, prefix)
+    assert n == 20
+    assert hydrated.store.keys == direct.store.keys
+    for key in direct.store.keys:
+        np.testing.assert_array_equal(
+            hydrated.store.columns[key], direct.store.columns[key]
+        )
+    np.testing.assert_array_equal(
+        hydrated.tree.leaves(), direct.tree.leaves()
+    )
+    # identical eligibility AND identical sample streams
+    assert hydrated.num_eligible == direct.num_eligible
+    for _ in range(4):
+        da, ia, wa = direct.sample(8)
+        db, ib, wb = hydrated.sample(8)
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(da["obs"], db["obs"])
+        np.testing.assert_array_equal(wa, wb)
+
+
+def test_prefill_transform_and_limit(tmp_path):
+    from blendjax.btt.file import FileRecorder
+
+    path = tmp_path / "raw.btr"
+    with FileRecorder(path, max_messages=16) as rec:
+        for k in range(10):
+            rec.save({"image": np.full((2, 2), k, np.uint8), "btid": 0})
+
+    buf = ReplayBuffer(16, seed=0)
+    n = prefill_from_btr(
+        buf, path,
+        transform=lambda m: None if int(m["image"][0, 0]) % 2 else {
+            "obs": m["image"].astype(np.float32).ravel()
+        },
+        limit=4,
+    )
+    assert n == 4
+    assert len(buf) == 4
+    rewardless = buf.get(0)
+    assert set(rewardless) == {"obs"}
+
+
+def test_prefill_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        prefill_from_btr(ReplayBuffer(4), str(tmp_path / "nope"))
+
+
+# -- health surface ----------------------------------------------------------
+
+
+def test_supervisor_health_reports_replay():
+    from blendjax.btt.supervise import FleetSupervisor
+    from blendjax.utils.timing import REPLAY_EVENTS, EventCounters
+
+    class StubLauncher:
+        launch_info = None
+
+    counters = EventCounters()
+    buf = ReplayBuffer(8, seed=0, counters=counters)
+    sup = FleetSupervisor(
+        StubLauncher(), pool=None, counters=counters, replay=buf
+    )
+    h = sup.health()
+    for name in REPLAY_EVENTS:
+        assert h[name] == 0  # zero-filled before any event
+    _fill(buf, 4)
+    h = sup.health()
+    assert h["replay_appends"] == 4
+    assert h["replay"]["size"] == 4
+    assert h["replay"]["capacity"] == 8
+    # attach-after-construction path
+    sup2 = FleetSupervisor(StubLauncher(), pool=None, counters=counters)
+    sup2.attach_replay(buf)
+    assert sup2.health()["replay"]["size"] == 4
+
+
+# -- live fleet interop ------------------------------------------------------
+
+
+@pytest.fixture
+def fake_blender(monkeypatch):
+    monkeypatch.setenv(
+        "BLENDJAX_BLENDER", os.path.join(HERE, "helpers", "fake_blender.py")
+    )
+
+
+def test_record_path_interop_live_envpool(fake_blender, tmp_path):
+    """A stream captured by FileRecorder during a live (fake-Blender)
+    EnvPool run prefills a ReplayBuffer bit-identically to direct
+    appends (the satellite acceptance scenario)."""
+    from blendjax.btt.envpool import launch_env_pool
+    from blendjax.btt.file import FileRecorder
+
+    prefix = str(tmp_path / "live")
+    direct = ReplayBuffer(256, seed=4)
+    rng = np.random.default_rng(0)
+    with launch_env_pool(
+        scene="",
+        script=ENV_SCRIPT,
+        num_instances=2,
+        background=True,
+        horizon=1_000_000,
+        timeoutms=30000,
+        start_port=14830,
+    ) as pool:
+        obs, _ = pool.reset()
+        obs = np.asarray(obs, np.float32).reshape(pool.num_envs, -1)
+        with FileRecorder(
+            FileRecorder.filename(prefix, 0), max_messages=256
+        ) as rec:
+            for _ in range(12):
+                actions = rng.integers(0, 2, pool.num_envs).astype(float)
+                nobs, rew, done, infos = pool.step(list(actions))
+                nobs = np.asarray(nobs, np.float32).reshape(
+                    pool.num_envs, -1
+                )
+                for i in range(pool.num_envs):
+                    tr = {
+                        "obs": obs[i],
+                        "action": np.float32(actions[i]),
+                        "reward": np.float32(rew[i]),
+                        "next_obs": nobs[i],
+                        "done": bool(done[i]),
+                    }
+                    healthy = bool(infos[i].get("healthy", True))
+                    rec.save(transition_to_message(tr, healthy=healthy))
+                    direct.append(tr, healthy=healthy)
+                obs = nobs
+
+    hydrated = ReplayBuffer(256, seed=4)
+    n = prefill_from_btr(hydrated, prefix)
+    assert n == 24
+    for key in direct.store.keys:
+        np.testing.assert_array_equal(
+            hydrated.store.columns[key], direct.store.columns[key]
+        )
+    da, ia, wa = direct.sample(8)
+    db, ib, wb = hydrated.sample(8)
+    np.testing.assert_array_equal(ia, ib)
+    np.testing.assert_array_equal(da["obs"], db["obs"])
+
+
+# -- benchmark schema --------------------------------------------------------
+
+
+def test_replay_benchmark_schema_and_floor():
+    """Fast schema check: tiny windows, keys locked to
+    ``REPLAY_BENCH_KEYS`` (the full-length acceptance run is
+    ``make replaybench``)."""
+    from benchmarks._common import REPLAY_BENCH_KEYS
+    from benchmarks.replay_benchmark import measure
+
+    rec = measure(width=32, height=24, batch=8, capacity=128, seconds=3.0)
+    assert set(REPLAY_BENCH_KEYS) <= set(rec)
+    assert rec["replay_appends_per_sec"] > 0
+    assert rec["replay_batches_per_sec"]["columnar"] > 0
+
+
+@pytest.mark.slow
+def test_replay_sample_x_meets_floor():
+    """Throughput-sensitive: the acceptance-geometry run
+    (160x120x3, batch 32) must show the columnar win.  The make target's
+    acceptance floor is 2.0; asserted at 1.5 here to absorb shared-CI
+    scheduler noise."""
+    from benchmarks.replay_benchmark import measure
+
+    rec = measure(batch=32, seconds=6.0)
+    assert rec["replay_sample_x"] >= 1.5, rec
+    assert rec["record_buffered_x"] is not None
