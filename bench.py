@@ -329,10 +329,33 @@ def main():
             min(75, remaining),
         )
         rl_pipelined = rl_lines[-1] if rl_lines else None
+    # fourth configuration: the Sebulba sharded actor-learner on the
+    # 8-fake-device MULTICHIP harness (4 fleets feeding a P('data')-
+    # sharded learner vs the single-fleet/single-device path) —
+    # interleaved window pairs, median ratio rl_sharded_x.  8 ms physics
+    # puts the fleet in the simulation-bound regime the sharded split
+    # scales (see make rlbench-sharded); the child forces its own
+    # virtual-device count before importing jax
+    rl_sharded = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 75:
+        rl_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "rl_benchmark.py"),
+                "--instances", str(instances),
+                "--seconds", "24",
+                "--physics-us", "8000",
+                "--sharded", "--mesh-devices", "8", "--fleets", "4",
+            ],
+            rl_env,
+            min(120, remaining),
+        )
+        rl_sharded = rl_lines[-1] if rl_lines else None
 
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
-                   replay_bench=replay_bench)
+                   replay_bench=replay_bench, rl_sharded=rl_sharded)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -375,6 +398,7 @@ HEADLINE_ABBREV = (
 #: partial/degraded markers are never dropped.
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
+    ("rl_sharded_x",),
     ("replay_sample_x",),
     ("feed_arena_x",),
     ("rl_pipelined_x",),
@@ -405,6 +429,10 @@ def headline(out):
     if out.get("rl_pipelined_x") is not None:
         # async pipelined EnvPool speedup over lock-step at physics 250us
         line["rl_pipelined_x"] = out["rl_pipelined_x"]
+    if out.get("rl_sharded_x") is not None:
+        # Sebulba sharded actor-learner speedup over single-device at
+        # 4 fleets / 8 fake devices (simulation-bound, physics 8 ms)
+        line["rl_sharded_x"] = out["rl_sharded_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -456,7 +484,8 @@ def headline(out):
 
 
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
-             feed_bound=None, rl_pipelined=None, replay_bench=None):
+             feed_bound=None, rl_pipelined=None, replay_bench=None,
+             rl_sharded=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -700,6 +729,23 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
                 extras["rl_pipelined_x"] = round(
                     rl_pipelined["value"] / base, 3
                 )
+    if rl_sharded and rl_sharded.get("metric") == "rl_sharded_x":
+        # the Sebulba sharded actor-learner ratio (4 fleets feeding a
+        # P('data')-sharded learner over the 8-fake-device MULTICHIP
+        # harness vs single fleet/device; interleaved window pairs,
+        # simulation-bound physics — see docs/sharded_rl.md), with both
+        # absolute medians and the multi-fleet health aggregate
+        extras["rl_sharded_x"] = rl_sharded.get("value")
+        extras["rl_sharded_config"] = {
+            k: rl_sharded[k]
+            for k in ("mesh_devices", "fleets", "instances_per_fleet",
+                      "total_envs", "physics_us", "pair_ratios",
+                      "single_env_steps_per_sec",
+                      "sharded_env_steps_per_sec")
+            if k in rl_sharded
+        }
+        if "fleet_health" in rl_sharded:
+            extras["rl_sharded_fleet_health"] = rl_sharded["fleet_health"]
 
     def dims(p):
         # cpu-fallback phases may run shrunken frames, and the wire

@@ -21,6 +21,12 @@ collapses to max(physics, consumer work).  ``--compare`` runs lock-step
 then pipelined in one process and reports the ratio as
 ``rl_pipelined_x`` — the jax-free microbench behind ``make rlbench``.
 
+``--sharded --mesh-devices N --fleets K`` runs the Sebulba sharded
+configuration (docs/sharded_rl.md) against the single-device
+actor/learner on N fake CPU devices (the MULTICHIP harness):
+interleaved window pairs, median ratio reported as ``rl_sharded_x`` —
+``make rlbench-sharded``.
+
 Run: ``python benchmarks/rl_benchmark.py [--instances 4] [--seconds 10]``
 Prints one JSON line: aggregate env-steps/sec and vs_baseline vs 2000 Hz.
 """
@@ -40,13 +46,9 @@ if os.path.dirname(HERE) not in sys.path:
 REFERENCE_HZ = 2000.0  # Readme.md:95, physics-only stepping
 
 
-def launch_pool_for(args, pipeline_depth=1, port_salt=0):
-    """One copy of the fleet setup for both configurations: fake-Blender
-    fallback, env fixture script, and a randomized port base so
-    back-to-back benchmark children can't collide on the launcher's
-    default 11000 while lingering sockets drain."""
-    from blendjax.btt.envpool import launch_env_pool
-
+def _env_setup(args):
+    """Shared fleet fixture config: fake-Blender fallback, env fixture
+    script, per-env kwargs.  Returns ``(script, env_kwargs)``."""
     os.environ.setdefault(
         "BLENDJAX_BLENDER",
         os.path.join(
@@ -56,16 +58,29 @@ def launch_pool_for(args, pipeline_depth=1, port_salt=0):
     script = os.path.join(
         os.path.dirname(HERE), "tests", "blender", "env.blend.py"
     )
+    return script, dict(
+        horizon=1_000_000_000,  # episodes never end inside the window
+        physics_us=args.physics_us,
+    )
+
+
+def launch_pool_for(args, pipeline_depth=1, port_salt=0):
+    """One copy of the fleet setup for both configurations: fake-Blender
+    fallback, env fixture script, and a randomized port base so
+    back-to-back benchmark children can't collide on the launcher's
+    default 11000 while lingering sockets drain."""
+    from blendjax.btt.envpool import launch_env_pool
+
+    script, env_kwargs = _env_setup(args)
     return launch_env_pool(
         scene="",
         script=script,
         num_instances=args.instances,
         background=True,
         timeoutms=30000,
-        horizon=1_000_000_000,  # episodes never end inside the window
-        physics_us=args.physics_us,
         start_port=20000 + (os.getpid() * 37 + port_salt * 131) % 20000,
         pipeline_depth=pipeline_depth,
+        **env_kwargs,
     )
 
 
@@ -210,16 +225,21 @@ def run_podracer(args):
     """Overlapped actor/learner configuration (Sebulba, arXiv:2104.06272):
     env stepping + policy inference in an actor thread concurrent with
     jitted REINFORCE updates — RL throughput WITH learning, not just the
-    RPC stack."""
+    RPC stack.  ``--pipeline-depth K`` additionally routes rollout
+    collection through the pool's async path
+    (``ActorLearner(pipeline=True)``, K requests in flight per env)."""
     import numpy as np
 
     from blendjax.models.actor_learner import ActorLearner
 
     values = np.array([0.0, 1.0], np.float64)
-    with launch_pool_for(args) as pool:
+    depth = max(args.pipeline_depth, 1)
+    pipelined = args.pipeline_depth >= 1
+    with launch_pool_for(args, pipeline_depth=depth) as pool:
         al = ActorLearner(
             pool, obs_dim=1, num_actions=2, rollout_len=32, seed=0,
             action_map=lambda a: list(values[np.asarray(a)]),
+            pipeline=pipelined,
         )
         al.run(num_updates=2)  # warmup: absorbs jit compiles
         stats = al.run(seconds=args.seconds)  # the measured window
@@ -232,7 +252,107 @@ def run_podracer(args):
         "vs_baseline": round(stats["env_steps_per_sec"] / REFERENCE_HZ, 3),
         "includes_physics": args.physics_us > 0,
         "includes_learning": True,
+        "pipeline_depth": depth,
+        "pipelined": pipelined,
         "architecture": "sebulba (overlapped actor/learner)",
+    }
+
+
+def run_sharded_compare(args, pairs=3):
+    """Sebulba sharded vs single-device actor/learner on live fleets,
+    alternating measurement windows; one JSON line with the median
+    paired ratio (``rl_sharded_x``) — the acceptance microbench for the
+    sharded configuration (docs/sharded_rl.md).
+
+    Single-device side: 1 fleet of ``--instances`` envs, one actor
+    thread, plain ``jax.device_put`` learner (the old headline path,
+    which cannot scale past one device).  Sharded side: ``--fleets``
+    fleets of ``--instances`` envs each, one actor thread per fleet,
+    global batches pre-sharded ``P('data')`` over a ``--mesh-devices``
+    mesh.  Both fleets stay up for the whole run and windows interleave,
+    so the ratio cancels host drift exactly like ``rl_pipelined_x``.
+    """
+    import jax
+    import numpy as np
+
+    from blendjax.models.actor_learner import ActorLearner
+    from blendjax.parallel import FleetSet, make_mesh
+
+    script, env_kwargs = _env_setup(args)
+    base_port = 20000 + (os.getpid() * 37) % 18000
+    mesh = make_mesh(
+        {"data": args.mesh_devices}, jax.devices()[:args.mesh_devices]
+    )
+    values = np.array([0.0, 1.0], np.float64)
+
+    def amap(a):
+        return list(values[np.asarray(a)])
+
+    window_s = max(args.seconds / pairs, 3.0)
+    with FleetSet(
+        "", script, 1, args.instances, start_port=base_port,
+        timeoutms=30000, **env_kwargs,
+    ) as single_fs, FleetSet(
+        "", script, args.fleets, args.instances,
+        start_port=base_port + 1000, timeoutms=30000, **env_kwargs,
+    ) as shard_fs:
+        al_single = ActorLearner(
+            single_fs, obs_dim=1, num_actions=2, rollout_len=32, seed=0,
+            action_map=amap,
+        )
+        al_shard = ActorLearner(
+            shard_fs, obs_dim=1, num_actions=2, rollout_len=32, seed=0,
+            mesh=mesh, action_map=amap,
+        )
+        al_single.run(num_updates=2)  # warmup: absorbs jit compiles
+        al_shard.run(num_updates=2)
+        singles, shardeds, ratios = [], [], []
+        for _ in range(pairs):
+            singles.append(
+                al_single.run(seconds=window_s)["env_steps_per_sec"]
+            )
+            shardeds.append(
+                al_shard.run(seconds=window_s)["env_steps_per_sec"]
+            )
+            ratios.append(shardeds[-1] / max(singles[-1], 1e-9))
+        health = shard_fs.health()
+    med = sorted(ratios)[len(ratios) // 2]
+    return {
+        "metric": "rl_sharded_x",
+        "value": round(med, 3),
+        "unit": f"x (sharded {args.fleets}-fleet / single-device "
+                f"env-steps/sec with learning, median of {pairs} "
+                "interleaved pairs)",
+        "mesh_devices": args.mesh_devices,
+        "fleets": args.fleets,
+        "instances_per_fleet": args.instances,
+        "total_envs": args.fleets * args.instances,
+        "physics_us": args.physics_us,
+        "single_env_steps_per_sec": round(
+            sorted(singles)[len(singles) // 2], 1
+        ),
+        "sharded_env_steps_per_sec": round(
+            sorted(shardeds)[len(shardeds) // 2], 1
+        ),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        # multi-fleet observability rides in the artifact: aggregate
+        # quarantine/death counters plus the per-fleet breakdown
+        # (blendjax.btt.supervise.aggregate_health)
+        "fleet_health": {
+            "num_envs": health["num_envs"],
+            "healthy_envs": health["healthy_envs"],
+            "quarantines": health["quarantines"],
+            "deaths": health["deaths"],
+            "restarts": health["restarts"],
+            "dead_fleets": health["dead_fleets"],
+            "per_fleet": {
+                str(fid): {
+                    "healthy_envs": h.get("healthy_envs", 0),
+                    "quarantines": h.get("quarantines", 0),
+                }
+                for fid, h in health["fleets"].items()
+            },
+        },
     }
 
 
@@ -256,16 +376,52 @@ def main(argv=None):
     )
     ap.add_argument("--podracer", action="store_true",
                     help="overlapped actor/learner configuration")
+    ap.add_argument(
+        "--sharded", action="store_true",
+        help="sharded vs single-device actor/learner comparison "
+             "(rl_sharded_x) on a fake-device CPU mesh",
+    )
+    ap.add_argument(
+        "--mesh-devices", type=int, default=8,
+        help="data-axis size of the learner mesh in --sharded mode "
+             "(forced as fake CPU devices before jax initializes)",
+    )
+    ap.add_argument(
+        "--fleets", type=int, default=4,
+        help="env fleets on the sharded side of --sharded mode, each "
+             "with --instances envs",
+    )
     args = ap.parse_args(argv)
-    if args.compare:
+    if args.sharded:
+        # the mesh is virtual CPU devices (the MULTICHIP harness): force
+        # the device count BEFORE jax initializes, and keep the child off
+        # a possibly-slow accelerator tunnel
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count"
+                  f"={args.mesh_devices}"
+            ).strip()
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        print(json.dumps(run_sharded_compare(args)))
+    elif args.compare:
         if args.pipeline_depth < 1:
             args.pipeline_depth = 4
         print(json.dumps(run_compare(args)))
-    elif args.pipeline_depth >= 1:
-        print(json.dumps(run_pipelined(args)))
     elif args.podracer:
         # jax runs in this child: keep it off a possibly-slow accelerator
-        # tunnel — the policy is tiny and the subject is the RL stack
+        # tunnel — the policy is tiny and the subject is the RL stack.
+        # Checked BEFORE the bare pipelined branch: --podracer
+        # --pipeline-depth K is the PIPELINED podracer (the depth used
+        # to be silently ignored here — and the dispatch below used to
+        # shadow this branch entirely whenever a depth was given)
         import jax
 
         try:
@@ -273,6 +429,8 @@ def main(argv=None):
         except Exception:
             pass
         print(json.dumps(run_podracer(args)))
+    elif args.pipeline_depth >= 1:
+        print(json.dumps(run_pipelined(args)))
     else:
         print(json.dumps(run(args)))
 

@@ -191,6 +191,30 @@ def _resolve_arena(arena, dataset, collate_fn, num_workers, prefetch):
     )
 
 
+def own_arena_leaves(host_batch, arena):
+    """Host-copy the leaves of ``host_batch`` still backed by ``arena``
+    memory, returning a pytree safe to hold past the arena's recycle.
+
+    On the CPU backend ``jax.device_put`` zero-copies aligned numpy
+    arrays (``may_alias=False`` included): the resulting ``jax.Array``
+    ALIASES the arena buffer, so recycling the arena would let the next
+    batch's scatter mutate an already-transferred "device" batch in
+    place.  Leaves a copying transform already detached are passed
+    through untouched; real accelerators never need this — their H2D DMA
+    is the copy, fenced by ``block_until_ready`` before recycle.  Shared
+    by :func:`device_prefetch` and the podracer fan-in
+    (:meth:`blendjax.parallel.podracer.SegmentFanIn.to_device`)."""
+    bufs = tuple(arena.buffers.values())
+
+    def _own(x):
+        arr = np.asarray(x)
+        if any(np.may_share_memory(arr, b) for b in bufs):
+            return np.array(arr)
+        return x
+
+    return jax.tree.map(_own, host_batch)
+
+
 def put_batch(batch, sharding=None):
     """Place one host batch (numpy pytree) onto device(s).
 
@@ -271,24 +295,9 @@ def device_prefetch(iterator, size=2, sharding=None, transform=None, timer=None,
                     host_batch = transform(host_batch)
                 if isinstance(batch, ArenaBatch) and \
                         jax.default_backend() == "cpu":
-                    # CPU jax's device_put zero-copies aligned numpy
-                    # arrays (may_alias=False included): the jax.Array
-                    # ALIASES the arena buffer, so recycling below would
-                    # let the next batch's scatter mutate an already-
-                    # yielded "device" batch in place.  Host-copy the
-                    # leaves still backed by arena memory (a copying
-                    # transform's outputs already own theirs); real
-                    # accelerators skip all of it — their H2D DMA is the
-                    # copy, fenced by block_until_ready before recycle.
-                    arena_bufs = tuple(batch.arena.buffers.values())
-
-                    def _own(x, _bufs=arena_bufs):
-                        arr = np.asarray(x)
-                        if any(np.may_share_memory(arr, b) for b in _bufs):
-                            return np.array(arr)
-                        return x
-
-                    host_batch = jax.tree.map(_own, host_batch)
+                    # see own_arena_leaves: CPU device_put aliases arena
+                    # memory, so detach before the recycle below
+                    host_batch = own_arena_leaves(host_batch, batch.arena)
                 with timer.stage("device_put"):
                     if gate is not None:
                         with gate.transfer():

@@ -74,6 +74,12 @@ class FleetSupervisor:
         replay fill/exclusion state and stage timings alongside the
         fleet counters — one snapshot for the whole acting+learning
         story.
+    fleet_id: int | None
+        This fleet's index in a multi-fleet (Sebulba) deployment — the
+        breakdown key :func:`aggregate_health` reports per-fleet
+        counters under.  Give each fleet's supervisor its OWN
+        ``EventCounters`` so the per-fleet slices stay disjoint
+        (:class:`blendjax.parallel.podracer.FleetSet` does).
     """
 
     def __init__(
@@ -86,9 +92,11 @@ class FleetSupervisor:
         on_death=None,
         heal_interval=0.05,
         replay=None,
+        fleet_id=None,
     ):
         self.launcher = launcher
         self.pool = pool
+        self.fleet_id = fleet_id
         if counters is None:
             counters = pool.counters if pool is not None else fleet_counters
         self.counters = counters
@@ -202,6 +210,8 @@ class FleetSupervisor:
         h = dict.fromkeys(FLEET_EVENTS + REPLAY_EVENTS, 0)
         h.update(self.counters.snapshot())
         h["alive"] = self.watchdog.alive
+        if self.fleet_id is not None:
+            h["fleet_id"] = self.fleet_id
         if self.pool is not None:
             mask = self.pool.healthy
             h["num_envs"] = int(mask.size)
@@ -247,3 +257,44 @@ class FleetSupervisor:
             return all(bool(fn()) for fn in self._checks.values())
 
         return self._await(cond, timeout)
+
+
+def aggregate_health(supervisors):
+    """One health snapshot over a multi-fleet (Sebulba) deployment.
+
+    Every canonical counter (``FLEET_EVENTS`` + ``REPLAY_EVENTS``) is
+    summed across the fleets' supervisors — the quarantine/death/retry
+    totals the sharded bench surfaces — and each fleet's full
+    :meth:`FleetSupervisor.health` snapshot rides underneath, keyed by
+    its ``fleet_id`` (the per-fleet breakdown: the ``fleet_id``
+    dimension on the shared event vocabulary).  ``num_envs`` /
+    ``healthy_envs`` sum across fleets; ``alive`` is True only while
+    EVERY fleet's watchdog is alive; ``dead_fleets`` lists fleets whose
+    pool has no healthy env left (the mask a sharded learner zeroes —
+    see :class:`blendjax.parallel.podracer.SegmentFanIn`).
+    """
+    agg = dict.fromkeys(FLEET_EVENTS + REPLAY_EVENTS, 0)
+    fleets = {}
+    num_envs = healthy_envs = 0
+    alive = True
+    dead_fleets = []
+    for idx, sup in enumerate(supervisors):
+        h = sup.health()
+        fid = h.get("fleet_id", idx)
+        fleets[fid] = h
+        for name in FLEET_EVENTS + REPLAY_EVENTS:
+            agg[name] += int(h.get(name, 0))
+        num_envs += int(h.get("num_envs", 0))
+        healthy_envs += int(h.get("healthy_envs", 0))
+        alive = alive and bool(h.get("alive", False))
+        if h.get("num_envs", 0) and h.get("healthy_envs", 0) == 0:
+            dead_fleets.append(fid)
+    agg.update(
+        num_fleets=len(fleets),
+        num_envs=num_envs,
+        healthy_envs=healthy_envs,
+        alive=alive,
+        dead_fleets=dead_fleets,
+        fleets=fleets,
+    )
+    return agg

@@ -13,6 +13,20 @@ learner consumes fixed-length trajectory segments and publishes fresh
 params; staleness is bounded by the queue depth (actor policy lags the
 learner by at most ``queue_size`` updates — standard Sebulba trade).
 
+The **sharded configuration** (``mesh=``, ``num_fleets=``; see
+docs/sharded_rl.md) scales both halves horizontally: N actor threads —
+one per :class:`~blendjax.btt.envpool.EnvPool` fleet, each fleet with
+its own :class:`~blendjax.btt.supervise.FleetSupervisor` and port range
+(:class:`~blendjax.parallel.podracer.FleetSet`) — fan their rollout
+segments into ONE env-major global batch per update
+(:class:`~blendjax.parallel.podracer.SegmentFanIn`: arena-pooled
+assembly, divisibility padding + mask), which lands **pre-sharded along
+the batch axis** (``NamedSharding(mesh, P('data'))``) under a learner
+whose params are mesh-replicated, so XLA inserts the gradient psum over
+the mesh on its own.  A fleet that dies entirely is zero-masked out of
+the batch instead of stalling the learner; the replay off-policy tail
+shards its sampled batches identically.
+
 No reference counterpart (its RL story is one blocking env,
 ``pkg_pytorch/blendtorch/btt/env.py``); net-new capability like the
 SeqFormer stack.
@@ -36,13 +50,32 @@ from blendjax.models.train import TrainState, make_train_step
 log = logging.getLogger("blendjax")
 
 
+def _as_pools(pool):
+    """Normalize the ``pool`` argument: one EnvPool, a sequence of them
+    (one per fleet), a :class:`~blendjax.parallel.podracer.FleetSet`, or
+    None (fleet-less, for :meth:`ActorLearner.run_offline`)."""
+    if pool is None:
+        return []
+    if hasattr(pool, "pools"):  # FleetSet
+        return list(pool.pools)
+    if isinstance(pool, (list, tuple)):
+        return list(pool)
+    return [pool]
+
+
 class ActorLearner:
-    """Overlapped actor/learner REINFORCE over an :class:`EnvPool`.
+    """Overlapped actor/learner REINFORCE over one or more
+    :class:`EnvPool` fleets.
 
     Params
     ------
-    pool: EnvPool
-        Connected fleet (autoreset recommended); the caller owns it.
+    pool: EnvPool | sequence[EnvPool] | FleetSet | None
+        Connected fleet(s) (autoreset recommended); the caller owns
+        them.  A sequence (or a
+        :class:`~blendjax.parallel.podracer.FleetSet`) runs one actor
+        thread per fleet with the segments fanned into a single global
+        batch per update.  None is allowed for the pure off-policy path
+        (:meth:`run_offline`) and for mesh-only construction (tests).
     obs_dim, num_actions: int
         Policy sizes (``continuous=True`` for a Gaussian head).
     rollout_len: int
@@ -54,14 +87,23 @@ class ActorLearner:
         Maps the sampled action array (shape (N,)) to the list the
         producers expect (e.g. discrete index -> motor force).
     pipeline: bool
-        Double-buffer rollout collection over the pool's async path
+        Double-buffer rollout collection over each pool's async path
         (``step_async``/``step_wait_full``): actions are submitted first
         and the fleet simulates frame t+1 while the actor finalizes the
-        previous segment (the ``np.stack`` + queue handoff — including
+        previous segment (the segment stack + queue handoff — including
         any block on a full queue — happens inside the simulation
         window).  False keeps the lock-step ``pool.step`` loop.
+    mesh: jax.sharding.Mesh | None
+        Sebulba sharded learner: params replicate over the mesh, rollout
+        batches (and sampled replay batches) arrive sharded ``P('data')``
+        along the batch axis, and XLA lays the gradient psum over the
+        mesh.  Requires the env-major fan-in path (enabled automatically;
+        a single fleet over a mesh works too).
+    num_fleets: int | None
+        Validation/intent marker for the multi-fleet configuration; when
+        given it must match the number of pools passed.
     replay: blendjax.replay.ReplayBuffer | None
-        Off-policy path (docs/replay.md): the actor thread appends every
+        Off-policy path (docs/replay.md): the actor threads append every
         transition — quarantine-aware, so a degraded rollout's synthetic
         transitions land flagged and are never sampled — and the learner
         follows each on-policy update with ``replay_ratio`` sampled
@@ -72,29 +114,70 @@ class ActorLearner:
         Off-policy updates per on-policy update (0 = append-only: the
         buffer fills for later offline runs/checkpoints).
     replay_batch: int
-        Transitions per off-policy update.
+        Transitions per off-policy update; under ``mesh=`` it must
+        divide by the mesh's data-axis size (sampled batches shard the
+        same way the rollout batches do).
     """
 
     def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
                  queue_size=4, optimizer=None, gamma=0.99, seed=0,
                  continuous=False, action_map=None, pipeline=False,
+                 mesh=None, num_fleets=None,
                  replay=None, replay_ratio=0, replay_batch=64):
-        self.pool = pool
+        self.pools = _as_pools(pool)
+        if num_fleets is not None:
+            if self.pools and num_fleets != len(self.pools):
+                raise ValueError(
+                    f"num_fleets={num_fleets} but {len(self.pools)} pools "
+                    "were passed — pass one EnvPool per fleet (e.g. via "
+                    "blendjax.parallel.podracer.FleetSet)"
+                )
+        self.num_fleets = len(self.pools) or (num_fleets or 0)
+        #: first pool, kept for single-fleet back-compat call sites
+        self.pool = self.pools[0] if self.pools else None
         self.rollout_len = rollout_len
+        self.queue_size = queue_size
         self.gamma = gamma
         self.continuous = continuous
         self.pipeline = bool(pipeline)
         self.action_map = action_map or (lambda a: list(np.asarray(a)))
+        self.mesh = mesh
+        #: env-major fan-in path: any mesh, or more than one fleet
+        self._use_fanin = mesh is not None or len(self.pools) > 1
         params = policy.init(
             jax.random.PRNGKey(seed), obs_dim, num_actions,
             continuous=continuous,
         )
         self.opt = optimizer or optax.adam(3e-3)
-        self.state = TrainState.create(params, self.opt)
         self._seed = seed
-        #: snapshot the actor samples from; swapped atomically (CPython
-        #: attribute assignment) by the learner after each update
-        self._actor_params = params
+        self._batch_sharding = None
+        self._actor_device = None
+        if mesh is not None:
+            from blendjax.parallel.mesh import data_sharding
+            from blendjax.parallel.sharding import param_specs, shard_pytree
+
+            if "data" not in mesh.shape:
+                raise ValueError(f"mesh {mesh} has no 'data' axis")
+            self._batch_sharding = data_sharding(mesh)
+            self._data_size = int(mesh.shape["data"])
+            #: actors sample on ONE (default) device — an SPMD dispatch
+            #: over the whole mesh per env step costs ~10x more than the
+            #: tiny policy computes; the learner gathers a snapshot per
+            #: update.  UNCOMMITTED arrays on purpose: a device-committed
+            #: input pytree disables jit's default-device fast dispatch
+            #: path (measured ~3-6x per-call overhead on a small host),
+            #: and the actors dispatch once per env step
+            self._actor_device = True  # marker: gather snapshots
+            self._actor_params = jax.tree.map(
+                jnp.asarray, jax.device_get(params)
+            )
+            # replicate params over the mesh (rules={} -> every leaf P());
+            # the sharded BATCH is what makes XLA psum the gradients
+            params = shard_pytree(params, mesh, param_specs(params, {}))
+        else:
+            self._data_size = 1
+            self._actor_params = params
+        self.state = TrainState.create(params, self.opt)
 
         def _sample_step(params, key, obs):
             # one jitted dispatch per env step: key advance + sampling
@@ -106,18 +189,25 @@ class ActorLearner:
 
         self._sample = jax.jit(_sample_step)
 
-        def loss_fn(p, batch):
-            returns = policy.discounted_returns(
-                batch["rewards"], batch["dones"], gamma
-            )
-            t, n = batch["rewards"].shape
-            return policy.reinforce_loss(
-                p,
-                batch["obs"].reshape(t * n, -1),
-                batch["actions"].reshape(t * n, *batch["actions"].shape[2:]),
-                returns.reshape(t * n),
-                continuous=continuous,
-            )
+        if self._use_fanin:
+            from blendjax.parallel.podracer import make_segment_loss
+
+            loss_fn = make_segment_loss(gamma, continuous=continuous)
+        else:
+            def loss_fn(p, batch):
+                returns = policy.discounted_returns(
+                    batch["rewards"], batch["dones"], gamma
+                )
+                t, n = batch["rewards"].shape
+                return policy.reinforce_loss(
+                    p,
+                    batch["obs"].reshape(t * n, -1),
+                    batch["actions"].reshape(
+                        t * n, *batch["actions"].shape[2:]
+                    ),
+                    returns.reshape(t * n),
+                    continuous=continuous,
+                )
 
         # donate=False ON PURPOSE: the actor thread samples from a params
         # snapshot that must survive the next update; donating the state
@@ -129,6 +219,13 @@ class ActorLearner:
         self.replay_batch = int(replay_batch)
         if replay_ratio and replay is None:
             raise ValueError("replay_ratio > 0 requires a replay buffer")
+        if mesh is not None and replay is not None \
+                and self.replay_batch % self._data_size:
+            raise ValueError(
+                f"replay_batch={self.replay_batch} does not divide over "
+                f"the mesh's data axis ({self._data_size} shards); pick "
+                "batch sizes divisible by the mesh axes they shard over"
+            )
 
         def replay_loss_fn(p, batch):
             # importance-weighted single-step policy gradient over
@@ -155,18 +252,49 @@ class ActorLearner:
             else None
         )
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._fanin = None
         self._stop = threading.Event()
-        self._thread = None
-        self._actor_error = None
-        self._env_steps = 0
-        self._unhealthy_env_steps = 0
-        self._degraded = False
+        self._threads = []
+        self._thread = None  # single-fleet back-compat handle
+        self._actor_errors = [None] * max(1, self.num_fleets)
+        self._env_steps_by_fleet = [0] * max(1, self.num_fleets)
+        self._unhealthy_by_fleet = [0] * max(1, self.num_fleets)
+        self._degraded_by_fleet = [False] * max(1, self.num_fleets)
 
-    # -- actor side --------------------------------------------------------
+    # -- aggregate views -----------------------------------------------------
 
-    def _enqueue_segment(self, seg_lists):
-        """Stack a finished segment and hand it to the learner (bounded
-        put, re-checked against stop).  Returns False once stop is set."""
+    @property
+    def _env_steps(self):
+        return sum(self._env_steps_by_fleet)
+
+    @property
+    def _unhealthy_env_steps(self):
+        return sum(self._unhealthy_by_fleet)
+
+    @property
+    def _actor_error(self):
+        return next((e for e in self._actor_errors if e is not None), None)
+
+    def _publish_params(self):
+        """Swap the actors' sampling snapshot (atomic CPython attribute
+        assignment).  Under a mesh the snapshot is gathered off the mesh
+        onto uncommitted default-device arrays — per-env-step SPMD
+        dispatch over the whole mesh (or committed-device dispatch)
+        would dwarf the tiny policy's compute; see the constructor."""
+        if self._actor_device is not None:
+            self._actor_params = jax.tree.map(
+                jnp.asarray, jax.device_get(self.state.params)
+            )
+        else:
+            self._actor_params = self.state.params
+
+    # -- actor side ----------------------------------------------------------
+
+    def _enqueue_segment(self, fid, seg_lists):
+        """Hand a finished segment to the learner (bounded put,
+        re-checked against stop).  Returns False once stop is set."""
+        if self._fanin is not None:
+            return self._fanin.put_segment(fid, seg_lists, self._stop)
         seg = tuple(np.stack(col) for col in seg_lists)
         while not self._stop.is_set():
             try:
@@ -176,13 +304,14 @@ class ActorLearner:
                 continue
         return False
 
-    def _actor(self):
+    def _actor(self, fid, pool):
         try:
-            # derived from the constructor seed: runs are reproducible
+            # derived from the constructor seed, distinct per fleet:
+            # runs are reproducible, fleets decorrelated
             rng = jax.random.fold_in(
-                jax.random.PRNGKey(self._seed), 0xAC708
+                jax.random.PRNGKey(self._seed), 0xAC708 + fid
             )
-            obs, _ = self.pool.reset()
+            obs, _ = pool.reset()
             obs = np.asarray(obs, np.float32)
             if obs.ndim == 1:
                 obs = obs[:, None]
@@ -199,18 +328,18 @@ class ActorLearner:
                         # segment t (the stack + queue handoff below can
                         # even block on a full queue — the envs keep
                         # integrating physics through the stall)
-                        self.pool.step_async(self.action_map(action))
+                        pool.step_async(self.action_map(action))
                         if pending_seg is not None:
-                            if not self._enqueue_segment(pending_seg):
+                            if not self._enqueue_segment(fid, pending_seg):
                                 # stop arrived with a batch in flight:
                                 # drain it so the pool is reusable for
                                 # lock-step callers after run() returns
-                                self.pool.step_wait()
+                                pool.step_wait()
                                 return
                             pending_seg = None
-                        nobs, rew, done, infos = self.pool.step_wait_full()
+                        nobs, rew, done, infos = pool.step_wait_full()
                     else:
-                        nobs, rew, done, infos = self.pool.step(
+                        nobs, rew, done, infos = pool.step(
                             self.action_map(action)
                         )
                     # degraded-mode accounting: quarantined slots return
@@ -221,17 +350,20 @@ class ActorLearner:
                         1 for inf in infos if not inf.get("healthy", True)
                     )
                     if unhealthy:
-                        self._unhealthy_env_steps += unhealthy
-                        if not self._degraded:
-                            self._degraded = True
+                        self._unhealthy_by_fleet[fid] += unhealthy
+                        if not self._degraded_by_fleet[fid]:
+                            self._degraded_by_fleet[fid] = True
                             log.warning(
-                                "actor rollout degraded: %d/%d envs "
-                                "quarantined (synthetic transitions in "
-                                "the batch)", unhealthy, self.pool.num_envs,
+                                "actor rollout degraded (fleet %d): %d/%d "
+                                "envs quarantined (synthetic transitions "
+                                "in the batch)", fid, unhealthy,
+                                pool.num_envs,
                             )
-                    elif self._degraded:
-                        self._degraded = False
-                        log.warning("actor rollout healthy again")
+                    elif self._degraded_by_fleet[fid]:
+                        self._degraded_by_fleet[fid] = False
+                        log.warning(
+                            "actor rollout healthy again (fleet %d)", fid
+                        )
                     seg_obs.append(obs)
                     seg_act.append(action)
                     seg_rew.append(np.asarray(rew, np.float32))
@@ -253,25 +385,33 @@ class ActorLearner:
                                     "next_obs": obs[i],
                                     "done": seg_done[-1][i],
                                 }
-                                for i in range(self.pool.num_envs)
+                                for i in range(pool.num_envs)
                             ),
                             healthy=[
                                 inf.get("healthy", True) for inf in infos
                             ],
                         )
-                    self._env_steps += self.pool.num_envs
+                    self._env_steps_by_fleet[fid] += pool.num_envs
                 seg_lists = (seg_obs, seg_act, seg_rew, seg_done)
                 if self.pipeline:
                     # deferred into the next submission's simulation window
                     pending_seg = seg_lists
                 else:
-                    if not self._enqueue_segment(seg_lists):
+                    if not self._enqueue_segment(fid, seg_lists):
                         return
         except BaseException as exc:  # noqa: BLE001 - surfaced by learner
-            self._actor_error = exc
-            self._stop.set()
+            self._actor_errors[fid] = exc
+            if len(self.pools) <= 1:
+                self._stop.set()
+            else:
+                # multi-fleet: the OTHER fleets keep training — the
+                # fan-in zero-masks this fleet's rows from here on
+                log.warning(
+                    "actor fleet %d failed (%s: %s); remaining fleets "
+                    "continue", fid, type(exc).__name__, exc,
+                )
 
-    # -- learner side ------------------------------------------------------
+    # -- learner side --------------------------------------------------------
 
     def _replay_step_and_refresh(self, batch, idx, reward):
         """The shared off-policy post-draw block (online tail AND
@@ -279,20 +419,24 @@ class ActorLearner:
         sampled rows' priorities refreshed from |advantage| under the
         batch baseline (the same signal the loss weights)."""
         self.state, loss = self._replay_step(self.state, batch)
-        self._actor_params = self.state.params
+        self._publish_params()
         r = np.asarray(reward, np.float64)
         self.replay.update_priorities(idx, np.abs(r - r.mean()))
         return float(loss)
 
     def _replay_update(self, data, idx, weights):
-        """One off-policy update from a host-side sampled batch."""
-        batch = jax.device_put(
+        """One off-policy update from a host-side sampled batch, placed
+        with the same batch-axis sharding as the rollout batches."""
+        from blendjax.btt.prefetch import put_batch
+
+        batch = put_batch(
             {
                 "obs": data["obs"],
                 "action": data["action"],
                 "reward": data["reward"],
                 "is_weight": weights,
-            }
+            },
+            self._batch_sharding,
         )
         return self._replay_step_and_refresh(batch, idx, data["reward"])
 
@@ -323,13 +467,21 @@ class ActorLearner:
         :class:`~blendjax.btt.arena.ArenaPool` buffers and staged onto
         the device through ``device_prefetch`` — the PR-1 feed seam,
         driven by the sampler instead of the wire; sampling for batch
-        t+1 overlaps the update on batch t.  Returns a stats dict.
+        t+1 overlaps the update on batch t.  Under ``mesh=`` the batches
+        land pre-sharded ``P('data')`` exactly like the online paths.
+        Returns a stats dict.
         """
         from blendjax.btt.arena import ArenaPool
         from blendjax.btt.prefetch import device_prefetch
 
         if self.replay is None:
             raise RuntimeError("run_offline requires a replay buffer")
+        if self.mesh is not None and batch_size % self._data_size:
+            raise ValueError(
+                f"batch_size={batch_size} does not divide over the "
+                f"mesh's data axis ({self._data_size} shards); pick "
+                "batch sizes divisible by the mesh axes they shard over"
+            )
         pool = arena_pool or ArenaPool(pool_size=prefetch + 2)
         stop = threading.Event()
         gen = self.replay.sample_batches(
@@ -342,7 +494,8 @@ class ActorLearner:
         losses = []
         t0 = time.perf_counter()
         it = device_prefetch(
-            gen, size=prefetch, timer=self.replay.timer
+            gen, size=prefetch, sharding=self._batch_sharding,
+            timer=self.replay.timer,
         )
         try:
             for dev_batch in it:
@@ -372,6 +525,58 @@ class ActorLearner:
             "elapsed_s": round(elapsed, 3),
         }
 
+    def _fleet_alive(self, fid):
+        return (fid < len(self._threads)
+                and self._threads[fid].is_alive())
+
+    def _next_fanin_batch(self, deadline):
+        """One pre-sharded global batch from the fan-in, or ``None`` on
+        deadline/stop, or raises once EVERY fleet has failed."""
+        while True:
+            if deadline is not None and time.perf_counter() >= deadline:
+                return None
+            if self._stop.is_set():
+                # a single-fleet actor failure stops the run (legacy
+                # contract): surface it instead of ending silently
+                errs = [e for e in self._actor_errors if e is not None]
+                if errs and len(errs) == len(self.pools):
+                    raise RuntimeError(
+                        "actor thread failed" if len(self.pools) == 1
+                        else f"all {len(self.pools)} actor fleets failed"
+                    ) from errs[0]
+                return None
+            mono_deadline = None
+            if deadline is not None:
+                mono_deadline = (
+                    time.monotonic() + deadline - time.perf_counter()
+                )
+            segs = self._fanin.collect(
+                self._fleet_alive, self._stop, deadline=mono_deadline
+            )
+            if deadline is not None and time.perf_counter() >= deadline:
+                self._fanin.recycle_segments(segs)
+                return None
+            if segs:
+                reward_sum = sum(
+                    float(s.data["rewards"].sum()) for s in segs.values()
+                )
+                reward_n = sum(
+                    s.data["rewards"].size for s in segs.values()
+                )
+                batch = self._fanin.assemble(segs, stop_event=self._stop)
+                if batch is None:
+                    return None
+                dev = self._fanin.to_device(batch)
+                return dev, reward_sum / max(reward_n, 1)
+            if all(not self._fleet_alive(f)
+                   for f in range(len(self.pools))):
+                errs = [e for e in self._actor_errors if e is not None]
+                if errs:
+                    raise RuntimeError(
+                        f"all {len(self.pools)} actor fleets failed"
+                    ) from errs[0]
+                return None
+
     def run(self, num_updates=None, seconds=None):
         """Run the overlapped loop for ``num_updates`` learner steps OR a
         ``seconds`` wall-clock budget (whichever is given; both = either
@@ -381,7 +586,7 @@ class ActorLearner:
         counter, and an emptied queue (a previous run's buffered segments
         carry a stale policy and would also corrupt the throughput math).
         """
-        if self.pool is None:
+        if not self.pools:
             # constructible fleet-less for the pure off-policy path
             # (prefilled replay buffer): that path is run_offline()
             raise RuntimeError(
@@ -390,7 +595,7 @@ class ActorLearner:
             )
         if num_updates is None and seconds is None:
             raise ValueError("pass num_updates and/or seconds")
-        if self._thread is not None and self._thread.is_alive():
+        if any(t.is_alive() for t in self._threads):
             # a leaked actor (previous run's join timed out on a stalled
             # RPC) sharing the REQ sockets with a fresh one would corrupt
             # the zmq protocol and double-count env steps
@@ -399,21 +604,36 @@ class ActorLearner:
                 "pool or wait before re-running"
             )
         self._stop = threading.Event()
-        self._actor_error = None
-        self._env_steps = 0
-        self._unhealthy_env_steps = 0
-        self._degraded = False
+        self._actor_errors = [None] * len(self.pools)
+        self._env_steps_by_fleet = [0] * len(self.pools)
+        self._unhealthy_by_fleet = [0] * len(self.pools)
+        self._degraded_by_fleet = [False] * len(self.pools)
         try:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        self._thread = threading.Thread(
-            target=self._actor, daemon=True, name="bjx-actor"
-        )
+        if self._use_fanin:
+            from blendjax.parallel.podracer import SegmentFanIn
+
+            # fresh fan-in per run: empty queues, recycled arenas
+            self._fanin = SegmentFanIn(
+                [p.num_envs for p in self.pools],
+                mesh=self.mesh,
+                queue_size=self.queue_size,
+            )
+        self._threads = [
+            threading.Thread(
+                target=self._actor, args=(fid, p), daemon=True,
+                name=f"bjx-actor-{fid}",
+            )
+            for fid, p in enumerate(self.pools)
+        ]
+        self._thread = self._threads[0]  # back-compat handle
         t0 = time.perf_counter()
         deadline = t0 + seconds if seconds is not None else None
-        self._thread.start()
+        for t in self._threads:
+            t.start()
         losses, seg_rewards, replay_losses = [], [], []
         try:
             while True:
@@ -421,34 +641,42 @@ class ActorLearner:
                     break
                 if deadline is not None and time.perf_counter() >= deadline:
                     break
-                while True:
-                    if self._actor_error is not None:
-                        raise RuntimeError(
-                            "actor thread failed"
-                        ) from self._actor_error
-                    try:
-                        seg = self._q.get(timeout=0.5)
+                if self._fanin is not None:
+                    got = self._next_fanin_batch(deadline)
+                    if got is None:
                         break
-                    except queue.Empty:
-                        if (deadline is not None
-                                and time.perf_counter() >= deadline):
-                            seg = None
+                    batch, seg_reward = got
+                else:
+                    while True:
+                        if self._actor_error is not None:
+                            raise RuntimeError(
+                                "actor thread failed"
+                            ) from self._actor_error
+                        try:
+                            seg = self._q.get(timeout=0.5)
                             break
-                if seg is None:
-                    break
-                batch = jax.device_put(
-                    {"obs": seg[0], "actions": seg[1],
-                     "rewards": seg[2], "dones": seg[3]}
-                )
+                        except queue.Empty:
+                            if (deadline is not None
+                                    and time.perf_counter() >= deadline):
+                                seg = None
+                                break
+                    if seg is None:
+                        break
+                    batch = jax.device_put(
+                        {"obs": seg[0], "actions": seg[1],
+                         "rewards": seg[2], "dones": seg[3]}
+                    )
+                    seg_reward = float(seg[2].mean())
                 self.state, loss = self._step(self.state, batch)
-                self._actor_params = self.state.params
+                self._publish_params()
                 losses.append(float(loss))
-                seg_rewards.append(float(seg[2].mean()))
+                seg_rewards.append(seg_reward)
                 if self.replay is not None and self.replay_ratio > 0:
                     self._drain_replay_ratio(replay_losses)
         finally:
             self._stop.set()
-            self._thread.join(timeout=10)
+            for t in self._threads:
+                t.join(timeout=10)
         elapsed = time.perf_counter() - t0
         stats = {
             "updates": len(losses),
@@ -462,6 +690,14 @@ class ActorLearner:
             "losses": losses,
             "elapsed_s": round(elapsed, 3),
         }
+        if len(self.pools) > 1 or self.mesh is not None:
+            stats["num_fleets"] = len(self.pools)
+            stats["env_steps_by_fleet"] = list(self._env_steps_by_fleet)
+            stats["dead_fleets"] = [
+                fid for fid, e in enumerate(self._actor_errors)
+                if e is not None
+            ]
+            stats["sharded"] = self.mesh is not None
         if self.replay is not None:
             stats["replay_updates"] = len(replay_losses)
             stats["replay_losses"] = replay_losses

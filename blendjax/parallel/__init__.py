@@ -1,6 +1,11 @@
 """Mesh construction, sharding rules, and SPMD train-step builders."""
 
 from blendjax.parallel.mesh import data_mesh, data_sharding, make_mesh, replicated
+from blendjax.parallel.podracer import (
+    FleetSet,
+    SegmentFanIn,
+    make_segment_loss,
+)
 from blendjax.parallel.pipeline import (
     make_pipeline,
     make_pipeline_train,
@@ -30,6 +35,9 @@ __all__ = [
     "data_sharding",
     "make_mesh",
     "replicated",
+    "FleetSet",
+    "SegmentFanIn",
+    "make_segment_loss",
     "detector_rules",
     "seqformer_rules",
     "make_sharded_train_step",

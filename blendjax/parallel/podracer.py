@@ -1,0 +1,439 @@
+"""Sebulba sharded actor-learner plumbing (Podracer, arXiv:2104.06272).
+
+The single-fleet :class:`~blendjax.models.actor_learner.ActorLearner`
+tops out at one actor thread feeding one device: rollouts land via a
+plain ``jax.device_put`` and the learner's gradient never leaves that
+device.  This module owns everything between *N fleets* and a
+*mesh-sharded learner*:
+
+- :class:`FleetSet` — launches ``num_fleets`` independent Blender env
+  fleets (each with its own :class:`~blendjax.btt.launcher.BlenderLauncher`,
+  :class:`~blendjax.btt.envpool.EnvPool`,
+  :class:`~blendjax.btt.supervise.FleetSupervisor`, per-fleet
+  ``EventCounters``, and a disjoint port range) and aggregates their
+  health into one snapshot (``fleet_id``-dimensioned counters);
+- :class:`SegmentFanIn` — the queue fan-in: per-fleet rollout segments
+  (time-major ``(T, n_f, ...)``, stacked straight into recycled
+  per-fleet arena buffers) are assembled into ONE env-major global batch
+  ``(N_padded, T, ...)`` in a pooled global arena, zero-filled + masked
+  for divisibility padding and dead fleets, and placed **pre-sharded
+  along the batch axis** through
+  :func:`blendjax.btt.prefetch.put_batch` with
+  :func:`blendjax.parallel.mesh.data_sharding` (``NamedSharding(mesh,
+  P('data'))``) — so XLA sees a batch that is already split over the
+  mesh and inserts the gradient psum on its own;
+- :func:`make_segment_loss` — the masked env-major REINFORCE loss the
+  sharded learner runs (same math as
+  :func:`blendjax.models.policy.reinforce_loss` on the unmasked rows;
+  the DP-equivalence test in ``tests/test_actor_learner_sharded.py``
+  locks it).
+
+Layout convention: the single-fleet path keeps the reference's
+time-major ``(T, N)`` batches; the sharded path is **env-major**
+``(N, T)`` so the *leading* axis is the batch axis and ``P('data')``
+shards it directly (the put_batch divisibility error then names the axis
+the caller actually controls).  Envs that don't divide the mesh's data
+axis are padded with zero rows carried at weight 0 in ``batch['mask']``.
+
+See docs/sharded_rl.md for the end-to-end recipe.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import time
+
+import numpy as np
+
+from blendjax.btt.arena import ArenaBatch, ArenaPool
+
+log = logging.getLogger("blendjax")
+
+#: pytree keys of one rollout segment, in assembly order
+SEGMENT_KEYS = ("obs", "actions", "rewards", "dones")
+
+
+def padded_size(n, shard_count):
+    """Smallest multiple of ``shard_count`` >= ``n`` (the global batch's
+    padded env count; padding rows ride at mask weight 0)."""
+    if shard_count <= 1:
+        return n
+    return -(-n // shard_count) * shard_count
+
+
+def make_segment_loss(gamma=0.99, continuous=False):
+    """Masked REINFORCE over ENV-MAJOR ``(N, T)`` segment batches.
+
+    ``batch``: obs ``(N, T, D)``, actions ``(N, T[, A])``, rewards /
+    dones ``(N, T)``, mask ``(N,)`` — weight 0 rows are divisibility
+    padding or dead-fleet slices and contribute nothing to the loss,
+    the baseline, or the advantage normalization.  On an all-ones mask
+    this is exactly :func:`blendjax.models.policy.reinforce_loss` on the
+    transposed batch (population-std advantage normalization included),
+    so a sharded update matches a single-device update bit-for-allclose.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from blendjax.models import policy
+
+    def loss_fn(p, batch):
+        # returns scan over time: transpose to (T, N); the 'data' shard
+        # stays on the env axis so the scan partitions cleanly
+        returns = policy.discounted_returns(
+            batch["rewards"].T, batch["dones"].T, gamma
+        ).T  # (N, T)
+        if continuous:
+            logp = policy.gaussian_log_prob(p, batch["obs"], batch["actions"])
+        else:
+            logp = policy.categorical_log_prob(p, batch["obs"], batch["actions"])
+        w = jnp.broadcast_to(
+            batch["mask"].astype(jnp.float32)[:, None], returns.shape
+        )
+        wsum = jnp.maximum(w.sum(), 1.0)
+        mu = (w * returns).sum() / wsum
+        var = (w * (returns - mu) ** 2).sum() / wsum
+        adv = (returns - mu) / (jnp.sqrt(var) + 1e-6)
+        return -((w * logp * jax.lax.stop_gradient(adv)).sum() / wsum)
+
+    return loss_fn
+
+
+class SegmentFanIn:
+    """Fan-in of per-fleet rollout segments into pre-sharded global batches.
+
+    One bounded queue per fleet on the actor side; on the learner side
+    :meth:`collect` pulls one segment from every *live* fleet (a fleet
+    whose actor died is skipped once its queue drains — the learner never
+    stalls on a dead fleet), :meth:`assemble` scatters them env-major into
+    a recycled global arena with padding/dead rows zeroed and masked, and
+    :meth:`to_device` places the batch through ``put_batch`` with the
+    mesh's batch-axis sharding (or the default device when ``mesh`` is
+    None — the unsharded multi-fleet ablation).
+
+    Params
+    ------
+    fleet_sizes: sequence[int]
+        Envs per fleet, in fleet order; fleet ``f`` owns global rows
+        ``[offset_f, offset_f + n_f)``.
+    mesh: jax.sharding.Mesh | None
+        Learner mesh; the global env count pads up to a multiple of the
+        ``axis`` size so every leaf shards evenly.
+    axis: str
+        Mesh axis the batch shards over.
+    queue_size: int
+        Segments buffered per fleet (bounds actor-policy staleness
+        exactly like the single-fleet queue).
+    arena_pool / fleet_arena_pools:
+        Global-batch pool and per-fleet segment pools; sized from
+        ``queue_size`` when omitted.  Per-fleet segment stacking and the
+        global assembly both write into recycled arena buffers — the
+        PR-1 feed discipline, driven by rollouts instead of the wire.
+    """
+
+    def __init__(self, fleet_sizes, mesh=None, axis="data", queue_size=4,
+                 arena_pool=None, fleet_arena_pools=None):
+        self.fleet_sizes = [int(n) for n in fleet_sizes]
+        if not self.fleet_sizes or min(self.fleet_sizes) < 1:
+            raise ValueError(f"bad fleet sizes {fleet_sizes}")
+        self.num_fleets = len(self.fleet_sizes)
+        self.offsets = np.concatenate([[0], np.cumsum(self.fleet_sizes)])
+        self.n_real = int(self.offsets[-1])
+        self.mesh = mesh
+        self.axis = axis
+        if mesh is not None:
+            from blendjax.parallel.mesh import data_sharding
+
+            self.shard_count = int(mesh.shape[axis])
+            self.sharding = data_sharding(mesh, axis)
+        else:
+            self.shard_count = 1
+            self.sharding = None
+        self.n_padded = padded_size(self.n_real, self.shard_count)
+        self.queues = [
+            queue.Queue(maxsize=queue_size) for _ in range(self.num_fleets)
+        ]
+        self.arena_pool = arena_pool or ArenaPool(pool_size=3)
+        self.fleet_arena_pools = fleet_arena_pools or [
+            ArenaPool(pool_size=queue_size + 2)
+            for _ in range(self.num_fleets)
+        ]
+
+    # -- actor side ----------------------------------------------------------
+
+    def put_segment(self, fleet_id, seg_lists, stop_event):
+        """Stack a finished segment straight into a recycled per-fleet
+        arena buffer and enqueue it (bounded put, re-checked against
+        ``stop_event``).  ``seg_lists`` is the actor's per-key list of
+        per-step ``(n_f,...)`` arrays, ordered :data:`SEGMENT_KEYS`.
+        Returns False once stop is set (the segment is dropped and its
+        arena recycled)."""
+        arena = self.fleet_arena_pools[fleet_id].acquire(
+            stop_event=stop_event
+        )
+        if arena is None:
+            return False
+        data = {}
+        for key, col in zip(SEGMENT_KEYS, seg_lists):
+            first = np.asarray(col[0])
+            buf = arena.get_buffer(
+                key, (len(col),) + first.shape, first.dtype
+            )
+            np.stack(col, out=buf)
+            data[key] = buf
+        batch = ArenaBatch(data, arena)
+        while not stop_event.is_set():
+            try:
+                self.queues[fleet_id].put(batch, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        batch.recycle()
+        return False
+
+    # -- learner side --------------------------------------------------------
+
+    def collect(self, alive_fn, stop_event, deadline=None, poll=0.2):
+        """One segment per live fleet: ``{fleet_id: ArenaBatch}``.
+
+        A fleet with ``alive_fn(f)`` False AND an empty queue contributes
+        nothing (its rows will be zero-masked); a live-but-slow fleet is
+        waited on — quarantine keeps live fleets producing, so the only
+        unbounded stall is every fleet dying, which the caller detects.
+        Returns the partial dict immediately when ``stop_event`` sets or
+        ``deadline`` (``time.monotonic`` seconds) passes — the caller
+        must :meth:`recycle_segments` anything it does not assemble."""
+        out = {}
+        pending = set(range(self.num_fleets))
+        while pending:
+            if stop_event.is_set():
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            progressed = False
+            for f in sorted(pending):
+                try:
+                    out[f] = self.queues[f].get_nowait()
+                    pending.discard(f)
+                    progressed = True
+                except queue.Empty:
+                    if not alive_fn(f):
+                        # drain-then-drop: a dead actor may still owe a
+                        # final enqueued segment
+                        try:
+                            out[f] = self.queues[f].get_nowait()
+                            progressed = True
+                        except queue.Empty:
+                            pass
+                        pending.discard(f)
+            if pending and not progressed:
+                # park on one pending queue instead of spinning
+                f = min(pending)
+                try:
+                    out[f] = self.queues[f].get(timeout=poll)
+                    pending.discard(f)
+                except queue.Empty:
+                    pass
+        return out
+
+    @staticmethod
+    def recycle_segments(segs):
+        for s in segs.values():
+            s.recycle()
+
+    def assemble(self, segs, stop_event=None, timeout=30.0):
+        """Scatter per-fleet segments into one env-major global batch.
+
+        Returns an :class:`ArenaBatch` whose data is ``{obs, actions,
+        rewards, dones, mask}`` with leading axis ``n_padded`` — rows of
+        absent fleets and divisibility padding zero-filled and carried at
+        ``mask`` 0.  Fleet arenas recycle as soon as their rows are
+        copied; the global arena recycles after the device transfer
+        (:meth:`to_device`)."""
+        if not segs:
+            raise ValueError("assemble needs at least one fleet segment")
+        arena = self.arena_pool.acquire(timeout=timeout, stop_event=stop_event)
+        if arena is None:
+            if stop_event is not None and stop_event.is_set():
+                self.recycle_segments(segs)
+                return None
+            raise TimeoutError(
+                f"no global batch arena freed within {timeout:.1f}s "
+                f"(pool size {self.arena_pool.pool_size}); the learner "
+                "has stalled or the pool is undersized"
+            )
+        first = next(iter(segs.values())).data
+        t_len = first["rewards"].shape[0]
+        data = {}
+        for key in SEGMENT_KEYS:
+            tail = first[key].shape[2:]
+            buf = arena.get_buffer(
+                key, (self.n_padded, t_len) + tail, first[key].dtype
+            )
+            data[key] = buf
+        mask = arena.get_buffer("mask", (self.n_padded,), np.float32)
+        mask[:] = 0.0
+        for f, seg in segs.items():
+            o, n = int(self.offsets[f]), self.fleet_sizes[f]
+            for key in SEGMENT_KEYS:
+                # (T, n, ...) -> (n, T, ...) at the fleet's global offset
+                np.copyto(data[key][o:o + n], seg.data[key].swapaxes(0, 1))
+            mask[o:o + n] = 1.0
+            seg.recycle()
+        # zero the rows nobody wrote (dead fleets + padding): arenas
+        # recycle, so stale bytes from a previous batch would otherwise
+        # leak into the (masked, but still computed-on) rows
+        dead = mask == 0.0
+        if dead.any():
+            for key in SEGMENT_KEYS:
+                data[key][dead] = 0
+        data["mask"] = mask
+        return ArenaBatch(data, arena)
+
+    def to_device(self, batch):
+        """Place an assembled global batch pre-sharded on the mesh (or
+        the default device) and recycle its arena once the transfer has
+        completed — the same recycle-after-transfer contract as
+        :func:`blendjax.btt.prefetch.device_prefetch`."""
+        import jax
+
+        from blendjax.btt.prefetch import own_arena_leaves, put_batch
+
+        host = batch.data
+        if jax.default_backend() == "cpu":
+            # CPU device_put zero-copies aligned numpy arrays; recycling
+            # below would let the next assembly rewrite this batch in
+            # place (the PR-5 aliasing bug, same fix)
+            host = own_arena_leaves(host, batch.arena)
+        dev = put_batch(host, self.sharding)
+        jax.block_until_ready(dev)
+        batch.recycle()
+        return dev
+
+
+class FleetSet:
+    """N independent env fleets with one aggregate health surface.
+
+    Launches ``num_fleets`` fleets of ``envs_per_fleet`` producers each:
+    fleet ``f`` binds ports from ``start_port + f * port_stride`` (so
+    fleets never collide), steps through its own
+    :class:`~blendjax.btt.envpool.EnvPool` (quarantining, per-fleet
+    ``EventCounters``) and is watched by its own
+    :class:`~blendjax.btt.supervise.FleetSupervisor` carrying
+    ``fleet_id=f``.  :meth:`health` aggregates every fleet's snapshot —
+    counters summed, quarantine masks concatenated — via
+    :func:`blendjax.btt.supervise.aggregate_health`.
+
+    Use as a context manager; pass ``fleet_set.pools`` (or the set
+    itself) to :class:`~blendjax.models.actor_learner.ActorLearner`.
+    """
+
+    def __init__(self, scene, script, num_fleets, envs_per_fleet, *,
+                 background=True, start_port=21000, port_stride=100,
+                 timeoutms=None, fault_policy=None, supervise=True,
+                 interval=0.5, restart=True, **env_kwargs):
+        if num_fleets < 1 or envs_per_fleet < 1:
+            raise ValueError("num_fleets and envs_per_fleet must be >= 1")
+        if envs_per_fleet * 2 > port_stride:
+            # each instance binds one GYM port (launchers may probe past
+            # collisions, hence the 2x margin): a fleet spilling into the
+            # next fleet's range would crosstalk with no useful error
+            raise ValueError(
+                f"envs_per_fleet={envs_per_fleet} does not fit in "
+                f"port_stride={port_stride}; raise port_stride to at "
+                "least 2x the fleet size"
+            )
+        self.num_fleets = num_fleets
+        self.envs_per_fleet = envs_per_fleet
+        self._cfg = dict(
+            scene=scene, script=script, background=background,
+            start_port=start_port, port_stride=port_stride,
+            timeoutms=timeoutms, fault_policy=fault_policy,
+            supervise=supervise, interval=interval, restart=restart,
+            env_kwargs=env_kwargs,
+        )
+        self.launchers = []
+        self.pools = []
+        self.supervisors = []
+        self._stack = []
+
+    def __enter__(self):
+        from blendjax.btt.constants import DEFAULT_TIMEOUTMS
+        from blendjax.btt.env import kwargs_to_cli
+        from blendjax.btt.envpool import EnvPool
+        from blendjax.btt.launcher import BlenderLauncher
+        from blendjax.btt.supervise import FleetSupervisor
+        from blendjax.utils.timing import EventCounters
+
+        cfg = self._cfg
+        try:
+            for f in range(self.num_fleets):
+                bl = BlenderLauncher(
+                    scene=cfg["scene"],
+                    script=cfg["script"],
+                    num_instances=self.envs_per_fleet,
+                    named_sockets=["GYM"],
+                    start_port=cfg["start_port"] + f * cfg["port_stride"],
+                    background=cfg["background"],
+                    instance_args=[
+                        list(kwargs_to_cli(cfg["env_kwargs"]))
+                        for _ in range(self.envs_per_fleet)
+                    ],
+                )
+                bl.__enter__()
+                self._stack.append(bl)
+                self.launchers.append(bl)
+            for f, bl in enumerate(self.launchers):
+                counters = EventCounters()
+                pool = EnvPool(
+                    bl.launch_info.addresses["GYM"],
+                    timeoutms=cfg["timeoutms"] or DEFAULT_TIMEOUTMS,
+                    fault_policy=cfg["fault_policy"],
+                    counters=counters,
+                )
+                self.pools.append(pool)
+                if cfg["supervise"]:
+                    sup = FleetSupervisor(
+                        bl, pool=pool, interval=cfg["interval"],
+                        restart=cfg["restart"], counters=counters,
+                        fleet_id=f,
+                    )
+                    sup.start()
+                    self.supervisors.append(sup)
+        except BaseException:
+            self.close()
+            raise
+        return self
+
+    def health(self):
+        """Aggregate multi-fleet health snapshot (see
+        :func:`blendjax.btt.supervise.aggregate_health`)."""
+        from blendjax.btt.supervise import aggregate_health
+
+        return aggregate_health(self.supervisors)
+
+    def close(self):
+        for sup in self.supervisors:
+            try:
+                sup.stop()
+            except Exception:
+                log.exception("fleet supervisor stop failed")
+        self.supervisors = []
+        for pool in self.pools:
+            try:
+                pool.close()
+            except Exception:
+                log.exception("fleet pool close failed")
+        self.pools = []
+        while self._stack:
+            bl = self._stack.pop()
+            try:
+                bl.__exit__(None, None, None)
+            except Exception:
+                log.exception("fleet launcher shutdown failed")
+        self.launchers = []
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,323 @@
+"""Sebulba sharded actor-learner tests (docs/sharded_rl.md) on the
+8-device virtual CPU mesh: DP-equivalence of the sharded learner update
+against the single-device path, fan-in assembly (padding, masking,
+stale-row zeroing, pre-sharded placement), multi-fleet end-to-end
+training over fake-Blender fleets, and the kill-one-fleet chaos
+acceptance (quarantine masks aggregate across fleets, no learner
+stall).  Named test_actor_learner_sharded (not test_sharded_rl) so it
+collects right after the single-fleet actor-learner tests, early in the
+tier-1 run."""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blendjax.models.actor_learner import ActorLearner
+from blendjax.parallel import FleetSet, SegmentFanIn, data_sharding, make_mesh
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ENV_SCRIPT = os.path.join(HERE, "blender", "env.blend.py")
+
+
+@pytest.fixture
+def fake_blender(monkeypatch):
+    monkeypatch.setenv(
+        "BLENDJAX_BLENDER", os.path.join(HERE, "helpers", "fake_blender.py")
+    )
+
+
+def _rollout(rng, t, n, d, num_actions=2):
+    """A fixed synthetic rollout, time-major (the single-device layout)."""
+    return {
+        "obs": rng.random((t, n, d)).astype(np.float32),
+        "actions": rng.integers(0, num_actions, (t, n)).astype(np.int32),
+        "rewards": rng.random((t, n)).astype(np.float32),
+        "dones": rng.random((t, n)) < 0.1,
+    }
+
+
+def _env_major(batch_tm, n_padded=None, mask=None):
+    """Transpose a time-major rollout to the sharded env-major layout."""
+    n = batch_tm["rewards"].shape[1]
+    n_padded = n_padded or n
+    out = {}
+    for k, v in batch_tm.items():
+        em = np.ascontiguousarray(v.swapaxes(0, 1))
+        if n_padded > n:
+            pad = np.zeros((n_padded - n,) + em.shape[1:], em.dtype)
+            em = np.concatenate([em, pad])
+        out[k] = em
+    if mask is None:
+        mask = np.zeros((n_padded,), np.float32)
+        mask[:n] = 1.0
+    out["mask"] = mask
+    return out
+
+
+class TestDpEquivalence:
+    """Mirrors tests/test_sharding.py::test_dp_equivalence_with_single_device
+    for the RL path: the same rollout through the sharded learner and the
+    single-device learner must produce the same update — ``rl_sharded_x``
+    measures speed, never silent divergence."""
+
+    def test_sharded_update_matches_single_device(self):
+        from blendjax.btt.prefetch import put_batch
+
+        mesh = make_mesh({"data": 8})
+        t, n, d = 16, 8, 3
+        batch_tm = _rollout(np.random.default_rng(0), t, n, d)
+        al_single = ActorLearner(None, obs_dim=d, num_actions=2, seed=3)
+        al_shard = ActorLearner(
+            None, obs_dim=d, num_actions=2, seed=3, mesh=mesh
+        )
+        b1 = jax.device_put(batch_tm)
+        b2 = put_batch(_env_major(batch_tm), data_sharding(mesh))
+        s1, l1 = al_single._step(al_single.state, b1)
+        s2, l2 = al_shard._step(al_shard.state, b2)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        for p1, p2 in zip(jax.tree.leaves(s1.params),
+                          jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(
+                np.asarray(p1), np.asarray(p2), rtol=1e-4, atol=1e-6
+            )
+
+    def test_padding_rows_do_not_change_the_update(self):
+        """6 envs over an 8-shard mesh pad to 8 masked rows; the update
+        must match the unpadded single-device one exactly (the padding
+        carries weight 0 through loss, baseline, and normalization)."""
+        from blendjax.btt.prefetch import put_batch
+
+        mesh = make_mesh({"data": 8})
+        t, n, d = 12, 6, 3
+        batch_tm = _rollout(np.random.default_rng(1), t, n, d)
+        al_single = ActorLearner(None, obs_dim=d, num_actions=2, seed=5)
+        al_shard = ActorLearner(
+            None, obs_dim=d, num_actions=2, seed=5, mesh=mesh
+        )
+        b1 = jax.device_put(batch_tm)
+        b2 = put_batch(
+            _env_major(batch_tm, n_padded=8), data_sharding(mesh)
+        )
+        s1, l1 = al_single._step(al_single.state, b1)
+        s2, l2 = al_shard._step(al_shard.state, b2)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        for p1, p2 in zip(jax.tree.leaves(s1.params),
+                          jax.tree.leaves(s2.params)):
+            np.testing.assert_allclose(
+                np.asarray(p1), np.asarray(p2), rtol=1e-4, atol=1e-6
+            )
+
+
+class TestSegmentFanIn:
+    def _seg_lists(self, rng, t, n, d, fill=None):
+        obs = [rng.random((n, d)).astype(np.float32) for _ in range(t)]
+        if fill is not None:
+            obs = [np.full((n, d), fill, np.float32) for _ in range(t)]
+        return (
+            obs,
+            [rng.integers(0, 2, (n,)).astype(np.int32) for _ in range(t)],
+            [rng.random((n,)).astype(np.float32) for _ in range(t)],
+            [np.zeros((n,), bool) for _ in range(t)],
+        )
+
+    def test_padding_and_presharded_placement(self):
+        """3 fleets x 2 envs over a 4-shard mesh: global batch pads 6 -> 8,
+        mask covers exactly the real rows, and the device batch lands
+        sharded P('data')."""
+        mesh = make_mesh({"data": 4}, jax.devices()[:4])
+        fanin = SegmentFanIn([2, 2, 2], mesh=mesh)
+        assert fanin.n_real == 6 and fanin.n_padded == 8
+        rng = np.random.default_rng(0)
+        stop = threading.Event()
+        for f in range(3):
+            assert fanin.put_segment(f, self._seg_lists(rng, 4, 2, 3), stop)
+        segs = fanin.collect(lambda f: True, stop)
+        assert sorted(segs) == [0, 1, 2]
+        batch = fanin.assemble(segs)
+        assert batch.data["obs"].shape == (8, 4, 3)
+        assert batch.data["mask"].tolist() == [1, 1, 1, 1, 1, 1, 0, 0]
+        dev = fanin.to_device(batch)
+        assert dev["obs"].sharding == data_sharding(mesh)
+        assert dev["rewards"].shape == (8, 4)
+
+    def test_dead_fleet_rows_zeroed_and_masked(self):
+        """A fleet whose actor died contributes nothing: its rows are
+        zero-filled (NOT stale bytes from the recycled arena) and
+        mask-excluded, and collect does not stall on it."""
+        fanin = SegmentFanIn([2, 2], mesh=None)
+        rng = np.random.default_rng(1)
+        stop = threading.Event()
+        # round 1: both fleets alive, fleet 1 writes a recognizable fill
+        fanin.put_segment(0, self._seg_lists(rng, 4, 2, 3), stop)
+        fanin.put_segment(1, self._seg_lists(rng, 4, 2, 3, fill=7.0), stop)
+        b1 = fanin.assemble(fanin.collect(lambda f: True, stop))
+        assert b1.data["mask"].tolist() == [1, 1, 1, 1]
+        b1.recycle()  # arena returns: round 2 reuses these exact buffers
+        # round 2: fleet 1 is dead — only fleet 0 contributes
+        fanin.put_segment(0, self._seg_lists(rng, 4, 2, 3), stop)
+        t0 = time.perf_counter()
+        segs = fanin.collect(lambda f: f == 0, stop)
+        assert time.perf_counter() - t0 < 5.0  # no stall on the dead fleet
+        assert sorted(segs) == [0]
+        b2 = fanin.assemble(segs)
+        assert b2.data["mask"].tolist() == [1, 1, 0, 0]
+        # the dead fleet's slice must be zeros, not round 1's 7.0 fill
+        np.testing.assert_array_equal(b2.data["obs"][2:], 0.0)
+
+    def test_collect_drains_dead_fleets_final_segment(self):
+        """A dead actor's already-enqueued segment still reaches the
+        learner before the fleet is masked out."""
+        fanin = SegmentFanIn([2], mesh=None)
+        rng = np.random.default_rng(2)
+        stop = threading.Event()
+        fanin.put_segment(0, self._seg_lists(rng, 2, 2, 3), stop)
+        segs = fanin.collect(lambda f: False, stop)  # actor already dead
+        assert sorted(segs) == [0]
+
+
+class TestMultiFleetTraining:
+    def test_two_fleets_sharded_end_to_end(self, fake_blender):
+        """2 fleets x 2 envs feeding a 4-device sharded learner: updates
+        land, both fleets contribute env steps, the echo policy improves,
+        and the aggregate health snapshot sees every fleet."""
+        values = np.array([0.0, 1.0], np.float64)
+        mesh = make_mesh({"data": 4}, jax.devices()[:4])
+        with FleetSet(
+            "", ENV_SCRIPT, num_fleets=2, envs_per_fleet=2,
+            start_port=15100, timeoutms=30000, horizon=1_000_000,
+        ) as fs:
+            al = ActorLearner(
+                fs, obs_dim=1, num_actions=2, rollout_len=16, seed=1,
+                mesh=mesh,
+                action_map=lambda a: list(values[np.asarray(a)]),
+            )
+            stats = al.run(num_updates=30)
+            health = fs.health()
+        assert stats["updates"] == 30
+        assert stats["num_fleets"] == 2 and stats["sharded"]
+        assert stats["dead_fleets"] == []
+        assert all(s > 0 for s in stats["env_steps_by_fleet"])
+        assert stats["env_steps"] == sum(stats["env_steps_by_fleet"])
+        # the policy learned the echo task (reward -> 0.1 optimum)
+        last = np.mean(stats["segment_rewards"][-5:])
+        assert last > np.mean(stats["segment_rewards"][:5])
+        assert last > 0.08, f"policy failed to converge: {last}"
+        # multi-fleet observability: per-fleet breakdown + aggregates
+        assert sorted(health["fleets"]) == [0, 1]
+        assert health["num_fleets"] == 2
+        assert health["num_envs"] == 4 and health["healthy_envs"] == 4
+        assert health["quarantines"] == 0 and health["dead_fleets"] == []
+        assert health["fleets"][0]["fleet_id"] == 0
+
+    def test_kill_one_fleet_keeps_training(self, fake_blender):
+        """THE sharded chaos acceptance: SIGKILL every producer of fleet 1
+        mid-run.  The learner must complete its update budget from the
+        surviving fleet (dead rows zero-masked, no stall), and the
+        aggregate health must show the quarantines on fleet 1 only."""
+        from blendjax.btt.chaos import kill_instance
+        from blendjax.btt.faults import FaultPolicy
+
+        values = np.array([0.0, 1.0], np.float64)
+        mesh = make_mesh({"data": 4}, jax.devices()[:4])
+        policy = FaultPolicy(
+            max_retries=1, backoff_base=0.05, deadline_s=2.0,
+            circuit_threshold=0, seed=7,
+        )
+        with FleetSet(
+            "", ENV_SCRIPT, num_fleets=2, envs_per_fleet=2,
+            start_port=15200, timeoutms=10000, fault_policy=policy,
+            restart=False, interval=0.2, horizon=1_000_000,
+        ) as fs:
+            al = ActorLearner(
+                fs, obs_dim=1, num_actions=2, rollout_len=8, seed=1,
+                mesh=mesh,
+                action_map=lambda a: list(values[np.asarray(a)]),
+            )
+
+            def killer():
+                # let both fleets contribute first, then kill fleet 1
+                while sum(al._env_steps_by_fleet) < 64:
+                    time.sleep(0.02)
+                kill_instance(fs.launchers[1], 0)
+                kill_instance(fs.launchers[1], 1)
+
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+            stats = al.run(num_updates=30)  # completing AT ALL = no stall
+            kt.join(timeout=10)
+            health = fs.health()
+        assert stats["updates"] == 30
+        assert stats["dead_fleets"] == [1]
+        assert stats["env_steps_by_fleet"][0] > \
+            stats["env_steps_by_fleet"][1]
+        # quarantine masks aggregate across fleets: totals carry fleet
+        # 1's two deaths, the per-fleet breakdown pins them to fleet 1
+        assert health["deaths"] >= 2 and health["quarantines"] >= 2
+        assert health["fleets"][0]["quarantines"] == 0
+        assert health["fleets"][1]["quarantines"] >= 2
+        assert health["dead_fleets"] == [1]
+        assert health["healthy_envs"] == 2 and health["num_envs"] == 4
+
+
+class TestShardedReplay:
+    def _filled_buffer(self, n=512, d=3):
+        from blendjax.replay import ReplayBuffer
+
+        buf = ReplayBuffer(1024, seed=0)
+        rng = np.random.default_rng(0)
+        buf.extend(
+            {
+                "obs": rng.random(d).astype(np.float32),
+                "action": np.int32(rng.integers(0, 2)),
+                "reward": np.float32(rng.random()),
+                "next_obs": rng.random(d).astype(np.float32),
+                "done": False,
+            }
+            for _ in range(n)
+        )
+        return buf
+
+    def test_offline_batches_land_sharded(self):
+        """run_offline under mesh=: sampled replay batches flow through
+        device_prefetch(sharding=) and the off-policy updates run against
+        P('data')-sharded batches — offline and off-policy shard
+        identically to the rollout path."""
+        mesh = make_mesh({"data": 8})
+        buf = self._filled_buffer()
+        al = ActorLearner(
+            None, obs_dim=3, num_actions=2, seed=2, mesh=mesh, replay=buf,
+        )
+        out = al.run_offline(num_updates=5, batch_size=32)
+        assert out["updates"] == 5
+        assert all(np.isfinite(v) for v in out["losses"])
+
+    def test_indivisible_replay_batch_rejected_early(self):
+        mesh = make_mesh({"data": 8})
+        buf = self._filled_buffer(64)
+        with pytest.raises(ValueError, match="divisible"):
+            ActorLearner(
+                None, obs_dim=3, num_actions=2, mesh=mesh, replay=buf,
+                replay_ratio=1, replay_batch=36,
+            )
+        al = ActorLearner(
+            None, obs_dim=3, num_actions=2, mesh=mesh, replay=buf,
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            al.run_offline(num_updates=1, batch_size=30)
+
+
+def test_fleetset_validates_sizes():
+    with pytest.raises(ValueError, match=">= 1"):
+        FleetSet("", ENV_SCRIPT, num_fleets=0, envs_per_fleet=2)
+
+
+def test_actor_learner_num_fleets_mismatch_raises(fake_blender):
+    with pytest.raises(ValueError, match="num_fleets"):
+        ActorLearner(
+            [object(), object()], obs_dim=1, num_actions=2, num_fleets=3,
+        )

@@ -94,3 +94,98 @@ def test_rl_benchmark_json_contract():
     assert res["metric"] == "rl_steps_per_sec_no_image"
     assert res["value"] > 0
     assert res["vs_baseline"] == pytest.approx(res["value"] / 2000.0, abs=1e-3)
+
+
+class _EchoStubPool:
+    """In-process stand-in for the fake-Blender EnvPool: obs echoes the
+    action, reward = action/10 — enough for ActorLearner's loop without
+    subprocess/zmq cost (the wire path has its own tests)."""
+
+    def __init__(self, n=2):
+        import numpy as np
+
+        self.np = np
+        self.num_envs = n
+        self._obs = np.zeros(n, np.float64)
+        self._pending = None
+
+    def _infos(self):
+        return [{"healthy": True}] * self.num_envs
+
+    def reset(self):
+        return self._obs.copy(), self._infos()
+
+    def _apply(self, actions):
+        a = self.np.asarray(actions, self.np.float64)
+        self._obs = a
+        return (a.copy(), a / 10.0,
+                self.np.zeros(self.num_envs, bool), self._infos())
+
+    def step(self, actions):
+        return self._apply(actions)
+
+    def step_async(self, actions, indices=None):
+        self._pending = actions
+
+    def step_wait_full(self, timeout_ms=None):
+        pending, self._pending = self._pending, None
+        return self._apply(pending)
+
+    def step_wait(self, min_ready=None, timeout_ms=None):
+        self._pending = None
+        return ([], self.np.empty((0,)), self.np.empty((0,)),
+                self.np.empty((0,), bool), [])
+
+
+def test_rl_benchmark_podracer_passes_pipeline_depth_through(monkeypatch):
+    """Regression (ISSUE 6 satellite): ``run_podracer`` used to call
+    ``launch_pool_for(args)`` with the default depth, silently ignoring
+    ``--pipeline-depth`` in podracer mode (and ``main``'s dispatch sent
+    ``--podracer --pipeline-depth K`` to the bare pipelined mode
+    instead).  The depth must reach the pool AND the result dict."""
+    import argparse
+    import contextlib
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        import rl_benchmark
+    finally:
+        sys.path.pop(0)
+
+    seen = {}
+
+    def spy(args, pipeline_depth=1, port_salt=0):
+        seen["depth"] = pipeline_depth
+        return contextlib.nullcontext(_EchoStubPool(args.instances))
+
+    monkeypatch.setattr(rl_benchmark, "launch_pool_for", spy)
+    args = argparse.Namespace(
+        instances=2, seconds=0.5, physics_us=0, pipeline_depth=2,
+    )
+    res = rl_benchmark.run_podracer(args)
+    assert seen["depth"] == 2
+    assert res["pipeline_depth"] == 2 and res["pipelined"] is True
+    assert res["metric"] == "rl_env_steps_per_sec_with_learning"
+    assert res["value"] > 0
+
+    # lock-step podracer keeps depth 1 and reports pipelined: False
+    args = argparse.Namespace(
+        instances=2, seconds=0.5, physics_us=0, pipeline_depth=0,
+    )
+    res = rl_benchmark.run_podracer(args)
+    assert seen["depth"] == 1
+    assert res["pipeline_depth"] == 1 and res["pipelined"] is False
+
+    # and main() must route --podracer --pipeline-depth to podracer mode
+    called = {}
+    monkeypatch.setattr(
+        rl_benchmark, "run_podracer",
+        lambda a: called.setdefault("podracer", a.pipeline_depth) or {},
+    )
+    monkeypatch.setattr(
+        rl_benchmark, "run_pipelined",
+        lambda a, **k: called.setdefault("pipelined", True) or {},
+    )
+    rl_benchmark.main(["--podracer", "--pipeline-depth", "3"])
+    assert called == {"podracer": 3}

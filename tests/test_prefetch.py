@@ -277,3 +277,44 @@ class TestPutBatchSharding:
         sh = NamedSharding(mesh, P("data", "seq", None))
         with pytest.raises(ValueError, match="not shardable"):
             put_batch({"obs": np.zeros((3, 64, 8), np.float32)}, sh)
+
+    def test_indivisible_batch_error_is_actionable_not_bare_xla(self):
+        """The error must tell the caller WHAT to change ("pick
+        batch/sequence sizes divisible ...") — not surface as a bare XLA
+        sharding exception naming neither the batch nor the axes."""
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from blendjax.btt.prefetch import put_batch
+        from blendjax.parallel import make_mesh
+
+        mesh = make_mesh({"data": 4}, jax.devices()[:4])
+        with pytest.raises(
+            ValueError, match="pick batch/sequence sizes divisible"
+        ) as exc:
+            put_batch({"x": np.zeros((6, 2), np.float32)},
+                      NamedSharding(mesh, P("data")))
+        assert "(6, 2)" in str(exc.value)  # the offending shape, named
+
+    def test_multi_axis_sharding_roundtrips_on_eight_devices(self):
+        """P('data','seq') over the FULL 8-device mesh: values (not just
+        the sharding attribute) survive the device round trip for every
+        leaf dtype the rollout feed ships."""
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from blendjax.btt.prefetch import put_batch
+        from blendjax.parallel import make_mesh
+
+        mesh = make_mesh({"data": 4, "seq": 2})  # all 8 fake devices
+        sh = NamedSharding(mesh, P("data", "seq"))
+        rng = np.random.default_rng(0)
+        batch = {
+            "obs": rng.random((8, 16, 5)).astype(np.float32),
+            "actions": rng.integers(0, 7, (8, 16)).astype(np.int32),
+            "dones": rng.random((8, 16)) < 0.3,
+        }
+        dev = put_batch(batch, sh)
+        for k in batch:
+            assert dev[k].sharding == sh
+            np.testing.assert_array_equal(np.asarray(dev[k]), batch[k])
