@@ -9,8 +9,8 @@ PYTHON ?= python
 # tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos blender-tests tpu-tests bench rlbench \
-	rlbench-sharded replaybench multichip dryrun
+.PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
+	rlbench rlbench-sharded replaybench multichip dryrun
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -39,6 +39,16 @@ tier1:
 chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		$(PYTHON) -m pytest tests/ -m chaos -q -rs
+
+# The replay-service shard chaos pack (tests/test_replay_service.py):
+# SIGKILL a shard process mid-training -> degraded sampling with strata
+# renormalized over live shards -> supervised respawn -> checkpoint +
+# .btr spill-tail restore -> re-admission with the draw stream
+# continuing bit-identically.  Subset of `make chaos` (same marker),
+# runnable alone for storage-tier work.  See docs/replay.md.
+chaos-replay:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_replay_service.py -m chaos -q -rs
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.
@@ -107,11 +117,15 @@ multichip:
 
 # Jax-free replay-path microbench: appends/sec into the columnar ring,
 # batched columnar vs naive per-item sampling (replay_sample_x, floor
-# 2.0 at batch 32), and the FileRecorder buffered-vs-unbuffered write
-# comparison.  One JSON line; see docs/replay.md.
+# 2.0 at batch 32), the FileRecorder buffered-vs-unbuffered write
+# comparison, and (--sharded) the replay-service windows — in-process
+# vs ShardedReplay over 2 in-process shard servers in interleaved
+# windows (replay_shard_x = the storage tier's wire tax) plus the
+# degraded-mode sampling overhead with one shard quarantined
+# (replay_degraded_x).  One JSON line; see docs/replay.md.
 replaybench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/replay_benchmark.py \
-		--batch 32 --seconds 6
+		--batch 32 --seconds 6 --sharded
 
 dryrun:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

@@ -207,11 +207,14 @@ def feed_bound_phase(seconds=3.0):
 def replay_bench_phase(seconds=5.0):
     """Measure the replay subsystem (benchmarks/replay_benchmark.py):
     ring append rate, batched columnar vs naive per-item sampling
-    (``replay_sample_x``), and the FileRecorder buffered-write win —
-    jax-free, in-process, same rationale as the feed-bound phase."""
+    (``replay_sample_x``), the FileRecorder buffered-write win, AND the
+    sharded replay-service comparison (in-process vs service windows ->
+    ``replay_shard_x``, plus the degraded-mode sampling overhead with a
+    shard quarantined -> ``replay_degraded_x``) — jax-free, in-process,
+    same rationale as the feed-bound phase."""
     from benchmarks.replay_benchmark import measure
 
-    return measure(seconds=seconds)
+    return measure(seconds=seconds, sharded=2)
 
 
 def main():
@@ -398,6 +401,7 @@ HEADLINE_ABBREV = (
 #: partial/degraded markers are never dropped.
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
+    ("replay_shard_x", "replay_degraded_x"),
     ("rl_sharded_x",),
     ("replay_sample_x",),
     ("feed_arena_x",),
@@ -426,6 +430,14 @@ def headline(out):
         # columnar batched replay sampling speedup over naive per-item
         # collation (batch 32) — the off-policy workload's feed ceiling
         line["replay_sample_x"] = rb["replay_sample_x"]
+    shard = (rb or {}).get("sharded")
+    if shard and shard.get("replay_shard_x") is not None:
+        # replay-service sampling rate over in-process (the wire tax of
+        # the sharded storage tier), with the degraded-mode overhead
+        # (one shard quarantined, strata renormalized) alongside
+        line["replay_shard_x"] = shard["replay_shard_x"]
+        if shard.get("replay_degraded_x") is not None:
+            line["replay_degraded_x"] = shard["replay_degraded_x"]
     if out.get("rl_pipelined_x") is not None:
         # async pipelined EnvPool speedup over lock-step at physics 250us
         line["rl_pipelined_x"] = out["rl_pipelined_x"]

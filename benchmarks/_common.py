@@ -34,6 +34,19 @@ REPLAY_BENCH_KEYS = (
     "stages",
 )
 
+#: Keys of the ``--sharded`` sub-record (``replay_bench["sharded"]``):
+#: in-process vs replay-*service* sampling over interleaved windows.
+#: ``replay_shard_x`` is service/in-process at the median pair (the wire
+#: tax of the storage tier); ``replay_degraded_x`` is degraded/healthy
+#: service rate with one shard quarantined (the strata-renormalization
+#: overhead a shard outage costs).
+REPLAY_SHARD_KEYS = (
+    "shards", "capacity", "batch",
+    "replay_shard_batches_per_sec",  # {"inproc", "service", "service_degraded"}
+    "replay_shard_x",
+    "replay_degraded_x",
+)
+
 
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)

@@ -23,9 +23,21 @@ by ``benchmarks/_common.REPLAY_BENCH_KEYS``):
   1 MiB write buffer flushed before the in-place header rewrite).
   Reported as ``record_buffered_x``.
 
+``--sharded`` adds the replay *service* measurement (keys
+``benchmarks/_common.REPLAY_SHARD_KEYS`` under ``"sharded"``): the same
+deterministic draw stream sampled from an in-process buffer vs a
+:class:`blendjax.replay.ShardedReplay` over N in-process shard servers
+(real wire protocol, loopback tcp), in interleaved A/B windows —
+``replay_shard_x`` is the service/in-process ratio at the median pair,
+i.e. the wire tax of promoting replay to the storage tier.  A third
+interleaved window runs with one shard quarantined and re-admitted
+around it — ``replay_degraded_x`` is the degraded/healthy service
+ratio, the measured cost of strata renormalization while a shard is
+down.
+
 Run via ``make replaybench`` (defaults below) or directly::
 
-    python benchmarks/replay_benchmark.py --batch 32 --seconds 6
+    python benchmarks/replay_benchmark.py --batch 32 --seconds 6 --sharded
 """
 
 from __future__ import annotations
@@ -183,9 +195,102 @@ def measure_record(width=160, height=120, channels=3, seconds=1.0,
     return out
 
 
+def measure_sharded(width=160, height=120, channels=3, batch=32,
+                    capacity=2048, shards=2, seconds=4.0, seed=0):
+    """In-process vs service sampling in interleaved windows, plus the
+    degraded-mode overhead (one shard quarantined mid-measurement and
+    re-admitted after) — the ``replay_shard_x`` / ``replay_degraded_x``
+    record.  Keys locked by ``REPLAY_SHARD_KEYS``."""
+    import numpy as np
+
+    from benchmarks._common import REPLAY_SHARD_KEYS
+    from blendjax.replay import ReplayBuffer, ShardedReplay
+    from blendjax.replay.service import start_shard_thread
+
+    rng = np.random.default_rng(seed)
+    transitions = [
+        _transition(rng, height, width, channels, np) for _ in range(64)
+    ]
+    # fill the WHOLE ring: every shard must hold rows, or the degraded
+    # window would quarantine an empty shard and measure nothing (the
+    # renormalization only costs anything when real mass leaves the
+    # draw domain)
+    fill = capacity
+    inproc = ReplayBuffer(capacity, seed=seed)
+    _fill(inproc, transitions, fill)
+    handles = [
+        start_shard_thread(capacity // shards, shard_id=i)
+        for i in range(shards)
+    ]
+    try:
+        service = ShardedReplay(
+            [h.address for h in handles], seed=seed
+        )
+        _fill(service, transitions, fill)
+        win = 0.25
+        rounds = max(4, int(seconds / (3 * win)))
+        _run_columnar(inproc, batch, 0.1)   # warmup all three paths
+        _run_columnar(service, batch, 0.1)
+        pairs = []
+        degraded_pairs = []
+        for _ in range(rounds):
+            inn, int_ = _run_columnar(inproc, batch, win)
+            svn, svt = _run_columnar(service, batch, win)
+            # degraded window: quarantine the last shard (its rows leave
+            # the draw domain, strata renormalize), then re-admit via
+            # the normal probe handshake — the shard thread never died,
+            # so re-admission is immediate and the next healthy window
+            # runs at full domain again.  A single-shard layout has no
+            # degraded mode to measure (quarantining its only shard
+            # leaves nothing drawable), so the window is skipped.
+            dgn, dgt = 0, 1.0
+            if shards > 1:
+                service.quarantine_shard(shards - 1, reason="bench window")
+                dgn, dgt = _run_columnar(service, batch, win)
+                if not service.probe():
+                    raise RuntimeError("bench shard failed to re-admit")
+            rate_in, rate_sv, rate_dg = inn / int_, svn / svt, dgn / dgt
+            if rate_in > 0:
+                pairs.append((rate_sv / rate_in, rate_in, rate_sv))
+            if shards > 1 and rate_sv > 0:
+                degraded_pairs.append((rate_dg / rate_sv, rate_dg))
+        pairs.sort()
+        degraded_pairs.sort()
+        ratio, rate_in, rate_sv = (
+            pairs[len(pairs) // 2] if pairs else (0.0, 0.0, 0.0)
+        )
+        dg_ratio, rate_dg = (
+            degraded_pairs[len(degraded_pairs) // 2]
+            if degraded_pairs else (0.0, 0.0)
+        )
+        rec = {
+            "shards": shards,
+            "capacity": capacity,
+            "batch": batch,
+            "replay_shard_batches_per_sec": {
+                "inproc": round(rate_in, 2),
+                "service": round(rate_sv, 2),
+                "service_degraded": round(rate_dg, 2),
+            },
+            "replay_shard_x": round(ratio, 3) if pairs else None,
+            "replay_degraded_x": (
+                round(dg_ratio, 3) if degraded_pairs else None
+            ),
+        }
+        service.close()
+    finally:
+        for h in handles:
+            h.close()
+    missing = [k for k in REPLAY_SHARD_KEYS if k not in rec]
+    assert not missing, f"replay shard schema drifted: missing {missing}"
+    return rec
+
+
 def measure(width=160, height=120, channels=3, batch=32, capacity=4096,
-            seconds=6.0, seed=0):
-    """The full replay_bench record (keys: ``REPLAY_BENCH_KEYS``)."""
+            seconds=6.0, seed=0, sharded=0):
+    """The full replay_bench record (keys: ``REPLAY_BENCH_KEYS``;
+    ``sharded`` > 0 adds the service comparison over that many
+    in-process shards under ``"sharded"``)."""
     from benchmarks._common import REPLAY_BENCH_KEYS
 
     budget = max(seconds, 3.0)
@@ -220,6 +325,12 @@ def measure(width=160, height=120, channels=3, batch=32, capacity=4096,
         ),
         "stages": buf.timer.summary(),
     }
+    if sharded:
+        rec["sharded"] = measure_sharded(
+            width, height, channels, batch=batch,
+            capacity=min(capacity, 2048), shards=sharded,
+            seconds=0.6 * budget, seed=seed,
+        )
     missing = [k for k in REPLAY_BENCH_KEYS if k not in rec]
     assert not missing, f"replay_bench schema drifted: missing {missing}"
     return rec
@@ -237,6 +348,12 @@ def main():
     ap.add_argument("--capacity", type=int, default=4096)
     ap.add_argument("--seconds", type=float, default=6.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sharded", action="store_true",
+                    help="add the in-process vs replay-service windows "
+                         "(replay_shard_x) and the degraded-mode "
+                         "overhead (replay_degraded_x)")
+    ap.add_argument("--shards", type=int, default=2,
+                    help="shard count for --sharded")
     args = ap.parse_args()
     print(
         json.dumps(
@@ -250,6 +367,7 @@ def main():
                     capacity=args.capacity,
                     seconds=args.seconds,
                     seed=args.seed,
+                    sharded=args.shards if args.sharded else 0,
                 ),
             }
         ),

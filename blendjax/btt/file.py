@@ -109,6 +109,16 @@ class FileRecorder:
             self.file.write(pickle.dumps(data, protocol=wire.PICKLE_PROTOCOL))
         return True
 
+    def flush(self):
+        """Push buffered records to the OS now.  The replay shard service
+        calls this before acknowledging an ``append`` RPC, so every row
+        the client has an ack for is recoverable from the spill log even
+        if the shard is SIGKILLed the next instant (crash-exact recovery:
+        see :func:`scan_messages` for how an unfinalized log is read
+        back)."""
+        if self.file is not None:
+            self.file.flush()
+
     def save_frames(self, frames):
         """Append a message captured as raw ZMQ frames.
 
@@ -137,6 +147,34 @@ class FileRecorder:
     def filename(prefix, worker_idx):
         """Per-worker file name ``{prefix}_{worker:02d}.btr``."""
         return f"{prefix}_{worker_idx:02d}.btr"
+
+
+def scan_messages(path):
+    """Yield messages from a ``.btr`` file **sequentially, ignoring the
+    offsets header** — the crash-recovery read path.
+
+    :class:`FileRecorder` rewrites its header only on clean close; a
+    recorder killed mid-stream leaves the header all ``-1``, which
+    :class:`FileReader` (correctly, for its random-access contract)
+    reads as an empty file.  Records are nonetheless laid out back to
+    back after the header, so this scanner recovers every fully-written
+    one: it unpickles the header to find where records start, then
+    unpickles records until EOF.  A torn final record (the crash landed
+    mid-``write``) ends the scan cleanly — everything before it was
+    flushed and is returned intact.
+    """
+    with io.open(path, "rb") as f:
+        try:
+            pickle.load(f)  # the (possibly unfinalized) offsets header
+        except (EOFError, pickle.UnpicklingError):
+            return
+        while True:
+            try:
+                yield pickle.load(f)
+            except (EOFError, pickle.UnpicklingError, AttributeError,
+                    MemoryError, ValueError):
+                # torn tail: the crash interrupted the last write
+                return
 
 
 class FileReader:

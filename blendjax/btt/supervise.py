@@ -68,12 +68,18 @@ class FleetSupervisor:
     heal_interval: float
         Heal-thread cadence, seconds (each tick drives pending
         re-admission probes).
-    replay: blendjax.replay.ReplayBuffer | None
+    replay: blendjax.replay.ReplayBuffer | ShardedReplay | None
         When the training loop runs off-policy, attach its buffer (here
         or via :meth:`attach_replay`) so :meth:`health` reports the
         replay fill/exclusion state and stage timings alongside the
         fleet counters — one snapshot for the whole acting+learning
-        story.
+        story.  A :class:`~blendjax.replay.ShardedReplay` is supervised
+        like a fleet: when this supervisor's launcher IS the shard
+        fleet (:class:`~blendjax.replay.service.ShardFleet`, pool
+        None), a shard-process death quarantines the matching shard
+        proactively and a respawn clears its backoff state; either way
+        the heal thread drives :meth:`ShardedReplay.probe` so restored
+        shards re-admit within the policy deadline.
     fleet_id: int | None
         This fleet's index in a multi-fleet (Sebulba) deployment — the
         breakdown key :func:`aggregate_health` reports per-fleet
@@ -160,12 +166,27 @@ class FleetSupervisor:
             self.pool.quarantine_env(
                 idx, reason=f"producer died (exit {code})"
             )
+        # a supervisor whose launcher is the replay shard fleet (pool
+        # None) maps instance deaths onto shard quarantine the same way
+        # an env supervisor maps them onto pool quarantine
+        rep = self.replay
+        rep_is_sharded = (
+            rep is not None and self.pool is None
+            and hasattr(rep, "quarantine_shard")
+            and idx < getattr(rep, "num_shards", 0)
+        )
+        if rep_is_sharded:
+            rep.quarantine_shard(
+                idx, reason=f"shard process died (exit {code})"
+            )
         if respawned:
             self.counters.incr("restarts")
             if self.pool is not None and idx < self.pool.num_envs:
                 # the endpoint is coming back: drop backoff/circuit state
                 # so the heal loop re-dials it immediately
                 self.pool.notify_respawn(idx)
+            if rep_is_sharded:
+                rep.notify_respawn(idx)
         elif self.watchdog.restart:
             self._down.add(idx)  # respawn failed; watchdog retries it
         self._event.set()
@@ -175,15 +196,24 @@ class FleetSupervisor:
     def _heal_loop(self):
         while not self._stop.wait(self.heal_interval):
             pool = self.pool
-            if pool is None:
-                continue
             try:
-                if pool.quarantined.any() and pool.probe(block_ms=20):
+                if pool is not None and pool.quarantined.any() \
+                        and pool.probe(block_ms=20):
                     self._event.set()
             except Exception:
                 # the heal loop shares the watchdog's prime directive:
                 # it must outlive whatever it is healing
                 logger.exception("supervisor heal tick failed")
+            rep = self.replay
+            if rep is None or not hasattr(rep, "probe"):
+                continue
+            try:
+                quarantined = getattr(rep, "quarantined", None)
+                if quarantined is not None and quarantined.any() \
+                        and rep.probe(block_ms=20):
+                    self._event.set()
+            except Exception:
+                logger.exception("supervisor replay heal tick failed")
 
     # -- stream verification --------------------------------------------------
 
@@ -248,11 +278,15 @@ class FleetSupervisor:
         return self._await(lambda: self.counters.get("deaths") >= n, timeout)
 
     def await_healthy(self, timeout=30.0):
-        """Block until every pool env is healthy and every registered
-        check passes.  True on success, False on timeout."""
+        """Block until every pool env is healthy, every replay shard is
+        re-admitted (when the attached replay is sharded), and every
+        registered check passes.  True on success, False on timeout."""
 
         def cond():
             if self.pool is not None and not self.pool.healthy.all():
+                return False
+            rep_q = getattr(self.replay, "quarantined", None)
+            if rep_q is not None and rep_q.any():
                 return False
             return all(bool(fn()) for fn in self._checks.values())
 

@@ -102,7 +102,7 @@ class ActorLearner:
     num_fleets: int | None
         Validation/intent marker for the multi-fleet configuration; when
         given it must match the number of pools passed.
-    replay: blendjax.replay.ReplayBuffer | None
+    replay: blendjax.replay.ReplayBuffer | ShardedReplay | None
         Off-policy path (docs/replay.md): the actor threads append every
         transition — quarantine-aware, so a degraded rollout's synthetic
         transitions land flagged and are never sampled — and the learner
@@ -110,6 +110,13 @@ class ActorLearner:
         off-policy updates (importance-weighted single-step policy
         gradient, priorities refreshed from |advantage|).  A prefilled
         buffer also trains with no fleet at all via :meth:`run_offline`.
+        A :class:`~blendjax.replay.ShardedReplay` (the replay *service*,
+        docs/replay.md "Sharded replay service") drops in transparently:
+        same sample/append surface, and a shard outage degrades the
+        off-policy tail (draws renormalize over live shards; a fully
+        starved draw is skipped and counted ``replay_sample_skips``)
+        instead of failing training — the storage tier survives faults
+        the same way the fleet does.
     replay_ratio: int
         Off-policy updates per on-policy update (0 = append-only: the
         buffer fills for later offline runs/checkpoints).
@@ -260,6 +267,15 @@ class ActorLearner:
         self._env_steps_by_fleet = [0] * max(1, self.num_fleets)
         self._unhealthy_by_fleet = [0] * max(1, self.num_fleets)
         self._degraded_by_fleet = [False] * max(1, self.num_fleets)
+        #: fleet re-admission (multi-fleet only): once the supervisor
+        #: heals a dead fleet's pool, the learner restarts its actor
+        #: thread so the fleet REJOINS the fan-in instead of staying
+        #: zero-masked forever; the cooldown stops a hot respawn loop
+        #: against a pool that immediately fails again
+        self.fleet_restart_cooldown = 1.0
+        self._fleet_restarts = [0] * max(1, self.num_fleets)
+        self._fleet_restart_allowed = [0.0] * max(1, self.num_fleets)
+        self._fleet_restart_steps = [0] * max(1, self.num_fleets)
 
     # -- aggregate views -----------------------------------------------------
 
@@ -455,6 +471,10 @@ class ActorLearner:
                     keys=("obs", "action", "reward"),
                 )
             except TimeoutError:
+                # underfilled buffer OR (sharded) a storage outage the
+                # quarantine could not route around — skip, keep the
+                # on-policy path moving, leave a countable trace
+                self.replay.counters.incr("replay_sample_skips")
                 return
             replay_losses.append(self._replay_update(data, idx, w))
 
@@ -529,12 +549,57 @@ class ActorLearner:
         return (fid < len(self._threads)
                 and self._threads[fid].is_alive())
 
+    def _maybe_restart_fleets(self):
+        """Fleet re-admission: a fleet whose actor thread died (every
+        env dead -> the pool raised) rejoins once the supervisor's heal
+        path has the pool answering again — `dead_fleets` shrinks
+        instead of zero-masking the fleet forever.  Single-fleet runs
+        keep the legacy fail-fast contract (the error stops the run)."""
+        if len(self.pools) <= 1 or self._stop.is_set():
+            return
+        now = time.monotonic()
+        for fid, pool in enumerate(self.pools):
+            if self._actor_errors[fid] is None or self._fleet_alive(fid):
+                continue
+            if now < self._fleet_restart_allowed[fid]:
+                continue
+            if (self._fleet_restarts[fid] > 0
+                    and self._env_steps_by_fleet[fid]
+                    <= self._fleet_restart_steps[fid]):
+                # the previous restart died without stepping a single
+                # env: the error is deterministic (bad action_map,
+                # schema drift), not a pool death — restarting forever
+                # would suppress it, so give up and leave the fleet in
+                # dead_fleets with its real exception
+                continue
+            healthy = getattr(pool, "healthy", None)
+            if healthy is not None and not np.asarray(healthy).any():
+                continue  # still dead; the supervisor owns the respawn
+            self._fleet_restart_allowed[fid] = (
+                now + self.fleet_restart_cooldown
+            )
+            self._fleet_restart_steps[fid] = self._env_steps_by_fleet[fid]
+            self._actor_errors[fid] = None
+            self._fleet_restarts[fid] += 1
+            t = threading.Thread(
+                target=self._actor, args=(fid, pool), daemon=True,
+                name=f"bjx-actor-{fid}.{self._fleet_restarts[fid]}",
+            )
+            self._threads[fid] = t
+            log.warning(
+                "fleet %d healed: restarting its actor thread "
+                "(restart %d); the fleet rejoins the fan-in", fid,
+                self._fleet_restarts[fid],
+            )
+            t.start()
+
     def _next_fanin_batch(self, deadline):
         """One pre-sharded global batch from the fan-in, or ``None`` on
         deadline/stop, or raises once EVERY fleet has failed."""
         while True:
             if deadline is not None and time.perf_counter() >= deadline:
                 return None
+            self._maybe_restart_fleets()
             if self._stop.is_set():
                 # a single-fleet actor failure stops the run (legacy
                 # contract): surface it instead of ending silently
@@ -608,6 +673,9 @@ class ActorLearner:
         self._env_steps_by_fleet = [0] * len(self.pools)
         self._unhealthy_by_fleet = [0] * len(self.pools)
         self._degraded_by_fleet = [False] * len(self.pools)
+        self._fleet_restarts = [0] * len(self.pools)
+        self._fleet_restart_allowed = [0.0] * len(self.pools)
+        self._fleet_restart_steps = [0] * len(self.pools)
         try:
             while True:
                 self._q.get_nowait()
@@ -697,6 +765,7 @@ class ActorLearner:
                 fid for fid, e in enumerate(self._actor_errors)
                 if e is not None
             ]
+            stats["fleet_restarts"] = list(self._fleet_restarts)
             stats["sharded"] = self.mesh is not None
         if self.replay is not None:
             stats["replay_updates"] = len(replay_losses)

@@ -17,6 +17,18 @@ Public surface::
     data, idx, w = buf.sample(32)
     buf.update_priorities(idx, errors)
     buf.save("replay.npz"); buf = ReplayBuffer.restore("replay.npz")
+
+The sharded service (docs/replay.md "Sharded replay service") keeps the
+same surface over remote storage shards — a drop-in for
+``ActorLearner(replay=)`` and ``run_offline`` that survives shard
+deaths (quarantine + degraded sampling + crash-exact re-admission)::
+
+    from blendjax.replay import ShardedReplay
+    from blendjax.replay.service import ShardFleet
+
+    with ShardFleet(4, capacity_per_shard=25_000, data_dir=d) as fleet:
+        buf = ShardedReplay(fleet.addresses, seed=0)
+        ...
 """
 
 from blendjax.replay.buffer import HEALTHY_KEY, ReplayBuffer
@@ -27,6 +39,11 @@ from blendjax.replay.prefill import (
     transition_to_message,
 )
 from blendjax.replay.ring import ColumnStore
+from blendjax.replay.shard_client import (
+    ShardClient,
+    ShardedReplay,
+    ShardRPCError,
+)
 from blendjax.replay.sumtree import SumTree
 
 __all__ = [
@@ -34,6 +51,9 @@ __all__ = [
     "ReplayBuffer",
     "ColumnStore",
     "SumTree",
+    "ShardedReplay",
+    "ShardClient",
+    "ShardRPCError",
     "prefill_from_btr",
     "iter_btr_transitions",
     "transition_to_message",

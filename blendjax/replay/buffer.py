@@ -54,6 +54,40 @@ from blendjax.utils.timing import StageTimer, fleet_counters
 HEALTHY_KEY = "healthy"
 
 
+def load_client_state(buf, arrays, meta):
+    """Apply checkpointed sampling state (eligibility masks, generations,
+    sum tree, ring indices, RNG) to a freshly-constructed buffer —
+    shared by :meth:`ReplayBuffer.restore` and the sharded client's
+    restore, whose storage lives on remote shards instead of in
+    ``arrays``."""
+    buf._valid = np.array(arrays["valid"], bool)
+    buf._healthy = np.array(arrays["healthy"], bool)
+    if "gen" in arrays:
+        buf._gen = np.array(arrays["gen"], np.int64)
+        buf._drawn_gen = np.array(arrays["drawn_gen"], np.int64)
+    if buf.tree is not None:
+        buf.tree.rebuild(arrays["tree_leaves"])
+    buf._head = int(meta["head"])
+    buf._size = int(meta["size"])
+    buf._num_valid = int(meta["num_valid"])
+    buf._max_priority = float(meta["max_priority"])
+    buf._appends = int(meta["appends"])
+    buf._overwrites = int(meta["overwrites"])
+    buf._excluded = int(meta["excluded"])
+    buf._samples = int(meta["samples"])
+    state = meta["rng_state"]
+    buf._rng = np.random.default_rng()
+    try:
+        buf._rng.bit_generator.state = state
+    except (ValueError, TypeError):
+        # a foreign bit generator (checkpoint written under a numpy
+        # whose default generator differs): rebuild it by name
+        bg = getattr(np.random, state["bit_generator"])()
+        bg.state = state
+        buf._rng = np.random.Generator(bg)
+    return buf
+
+
 class ReplayBuffer:
     """Thread-safe prioritized experience replay.
 
@@ -80,11 +114,17 @@ class ReplayBuffer:
         Records ``replay_append`` / ``sample_wait`` / ``sample_gather``
         / ``priority_update`` stages; a private timer is created when
         omitted (always inspectable via ``buffer.timer``).
+    name: str | None
+        Label this buffer carries in every error it raises (a degraded
+        run's traceback must identify WHICH buffer/shard starved without
+        log archaeology — the errors also embed a :meth:`stats`
+        digest).  Defaults to ``replay[<capacity>]``.
     """
 
     def __init__(self, capacity, *, seed=0, prioritized=True, alpha=0.6,
-                 beta=0.4, eps=1e-3, counters=None, timer=None):
+                 beta=0.4, eps=1e-3, counters=None, timer=None, name=None):
         self.capacity = int(capacity)
+        self.name = name or f"replay[{self.capacity}]"
         self.prioritized = bool(prioritized)
         self.alpha = float(alpha)
         self.beta = float(beta)
@@ -123,6 +163,23 @@ class ReplayBuffer:
         """Rows currently eligible for sampling (healthy, live)."""
         with self._cond:
             return self._num_valid
+
+    # -- error diagnostics ---------------------------------------------------
+
+    def _diag_locked(self):
+        """One-line stats digest for exception messages (caller holds the
+        lock; the lock is not reentrant).  A TimeoutError in a degraded
+        run must be diagnosable from the traceback alone (docs/replay.md),
+        so every starvation/shard error embeds this."""
+        return (
+            f"size={self._size}/{self.capacity} eligible={self._num_valid} "
+            f"excluded={self._excluded} appends={self._appends} "
+            f"overwrites={self._overwrites} samples={self._samples}"
+        )
+
+    def _diag(self):
+        with self._cond:
+            return self._diag_locked()
 
     # -- append side ---------------------------------------------------------
 
@@ -271,8 +328,9 @@ class ReplayBuffer:
                             "sample_wait", time.perf_counter() - t0, _t0=t0
                         )
                         raise TimeoutError(
-                            f"replay underfilled: {self._num_valid} eligible "
-                            f"rows < {need} after {timeout:.1f}s"
+                            f"{self.name}: underfilled — {self._num_valid} "
+                            f"eligible rows < {need} after {timeout:.1f}s "
+                            f"({self._diag_locked()})"
                         )
                     if not waited:
                         # counted only when the call actually blocks — a
@@ -360,9 +418,11 @@ class ReplayBuffer:
                     # run truncate silently (same contract as the feed
                     # path's _acquire_arena)
                     raise TimeoutError(
-                        f"no batch arena freed within {timeout:.1f}s "
-                        f"(pool size {arena_pool.pool_size}); the "
-                        "consumer has stalled or the pool is undersized"
+                        f"{self.name}: no batch arena freed within "
+                        f"{timeout:.1f}s (pool size "
+                        f"{arena_pool.pool_size}); the consumer has "
+                        "stalled or the pool is undersized "
+                        f"({self._diag()})"
                     )
                 # bind lazily per key (the Arena.get_buffer signature):
                 # the schema may not even exist yet while sample() blocks
@@ -392,37 +452,44 @@ class ReplayBuffer:
 
     # -- checkpoint ----------------------------------------------------------
 
+    def _state_arrays_meta_locked(self):
+        """The checkpointable client state (caller holds the lock) —
+        shared by :meth:`save` and the sharded subclass, which swaps the
+        format tag and rides shard bookkeeping alongside."""
+        arrays = dict(self.store.state_arrays())
+        arrays["valid"] = self._valid
+        arrays["healthy"] = self._healthy
+        arrays["gen"] = self._gen
+        arrays["drawn_gen"] = self._drawn_gen
+        if self.tree is not None:
+            arrays["tree_leaves"] = self.tree.leaves()
+        meta = {
+            "format": "blendjax.replay/1",
+            "capacity": self.capacity,
+            "head": self._head,
+            "size": self._size,
+            "num_valid": self._num_valid,
+            "seed": self.seed,
+            "prioritized": self.prioritized,
+            "alpha": self.alpha,
+            "beta": self.beta,
+            "eps": self.eps,
+            "max_priority": self._max_priority,
+            "appends": self._appends,
+            "overwrites": self._overwrites,
+            "excluded": self._excluded,
+            "samples": self._samples,
+            "rng_state": self._rng.bit_generator.state,
+        }
+        return arrays, meta
+
     def save(self, path):
         """Checkpoint buffer contents + sum tree + RNG state (atomic;
         :func:`blendjax.utils.checkpoint.save_state`)."""
         from blendjax.utils.checkpoint import save_state
 
         with self._cond:
-            arrays = dict(self.store.state_arrays())
-            arrays["valid"] = self._valid
-            arrays["healthy"] = self._healthy
-            arrays["gen"] = self._gen
-            arrays["drawn_gen"] = self._drawn_gen
-            if self.tree is not None:
-                arrays["tree_leaves"] = self.tree.leaves()
-            meta = {
-                "format": "blendjax.replay/1",
-                "capacity": self.capacity,
-                "head": self._head,
-                "size": self._size,
-                "num_valid": self._num_valid,
-                "seed": self.seed,
-                "prioritized": self.prioritized,
-                "alpha": self.alpha,
-                "beta": self.beta,
-                "eps": self.eps,
-                "max_priority": self._max_priority,
-                "appends": self._appends,
-                "overwrites": self._overwrites,
-                "excluded": self._excluded,
-                "samples": self._samples,
-                "rng_state": self._rng.bit_generator.state,
-            }
+            arrays, meta = self._state_arrays_meta_locked()
             save_state(path, arrays, meta)
         return path
 
@@ -444,31 +511,7 @@ class ReplayBuffer:
             counters=counters, timer=timer,
         )
         buf.store.load_state_arrays(arrays)
-        buf._valid = np.array(arrays["valid"], bool)
-        buf._healthy = np.array(arrays["healthy"], bool)
-        if "gen" in arrays:
-            buf._gen = np.array(arrays["gen"], np.int64)
-            buf._drawn_gen = np.array(arrays["drawn_gen"], np.int64)
-        if buf.tree is not None:
-            buf.tree.rebuild(arrays["tree_leaves"])
-        buf._head = int(meta["head"])
-        buf._size = int(meta["size"])
-        buf._num_valid = int(meta["num_valid"])
-        buf._max_priority = float(meta["max_priority"])
-        buf._appends = int(meta["appends"])
-        buf._overwrites = int(meta["overwrites"])
-        buf._excluded = int(meta["excluded"])
-        buf._samples = int(meta["samples"])
-        state = meta["rng_state"]
-        buf._rng = np.random.default_rng()
-        try:
-            buf._rng.bit_generator.state = state
-        except (ValueError, TypeError):
-            # a foreign bit generator (checkpoint written under a numpy
-            # whose default generator differs): rebuild it by name
-            bg = getattr(np.random, state["bit_generator"])()
-            bg.state = state
-            buf._rng = np.random.Generator(bg)
+        load_client_state(buf, arrays, meta)
         return buf
 
     # -- observability -------------------------------------------------------
@@ -478,6 +521,7 @@ class ReplayBuffer:
         exclusion accounting, and the replay stage timings."""
         with self._cond:
             return {
+                "name": self.name,
                 "size": self._size,
                 "capacity": self.capacity,
                 "eligible": self._num_valid,

@@ -1,0 +1,571 @@
+"""Replay shard service: one durable :class:`ColumnStore` behind the wire.
+
+ROADMAP #2 promotes :class:`~blendjax.replay.ReplayBuffer` from an
+in-process object to the system's **storage layer**: a sharded, tiered
+service actors and learners reach over the wire, whose failures are
+handled with the same ``FaultPolicy``/quarantine vocabulary the EnvPool
+speaks (Podracer architectures, arXiv:2104.06272, assume exactly this
+tier).  The split of responsibilities:
+
+- a **shard** (this module) is *storage + durability*: a columnar ring
+  (:class:`~blendjax.replay.ring.ColumnStore`) served over the existing
+  DEALER<->REP wire protocol, every accepted append journaled to a
+  ``.btr`` spill log (the cold tier — :class:`~blendjax.btt.file.
+  FileRecorder`, flushed **before** the ack, so an acked row survives a
+  SIGKILL the next instant) and periodically checkpointed atomically
+  (:func:`blendjax.utils.checkpoint.save_state`).  Restart = load the
+  latest checkpoint, replay the spill tail (crash-tolerant
+  :func:`~blendjax.btt.file.scan_messages` scan), serve — bit-identical
+  pre-crash contents;
+- the **client** (:class:`~blendjax.replay.shard_client.ShardedReplay`)
+  owns every sampling decision: the global sum tree, the seeded RNG,
+  eligibility/generation masks.  Shards therefore never need to agree
+  on a draw, and a dead shard costs exactly its slot range — see
+  docs/replay.md ("Sharded replay service").
+
+Exactly-once RPCs: the client stamps every request with a
+``wire.BTMID_KEY`` correlation id and a fault-policy retry re-sends the
+SAME id; the shard answers a retried mutating request (``append``,
+``save``) from a bounded reply cache instead of applying it twice —
+the ``RemoteControlledAgent`` reply-cache pattern, pointed at storage.
+
+Run a shard as a process (jax-free, fast start)::
+
+    python -m blendjax.replay.service --address tcp://127.0.0.1:23000 \
+        --capacity 65536 --shard-id 0 --dir /data/replay \
+        --checkpoint-every 4096
+
+or in-process for tests/benchmarks via :func:`start_shard_thread`, or
+as a supervised fleet via :class:`ShardFleet` (a launcher-compatible
+surface, so :class:`~blendjax.btt.supervise.FleetSupervisor` respawns
+dead shard processes and drives the client's re-admission probes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from blendjax import wire
+from blendjax.btt.file import FileRecorder, scan_messages
+from blendjax.replay.ring import ColumnStore
+from blendjax.utils.timing import fleet_counters
+
+logger = logging.getLogger("blendjax")
+
+#: Checkpoint format tag (shard side; the client checkpoint carries
+#: ``blendjax.replay.sharded/1``).
+SHARD_FORMAT = "blendjax.replay.shard/1"
+
+#: Spill-log capacity per file when auto-checkpointing is off.  A spill
+#: that fills forces a checkpoint (rotating to a fresh file) rather
+#: than dropping records — the append ack promises durability — so this
+#: also bounds the recovery-replay tail.  Kept moderate because the
+#: ``.btr`` header is a pickled int64 offsets array of this length,
+#: written at open and rewritten at close (8 bytes/slot of header I/O
+#: per rotation).
+SPILL_CAPACITY = 65536
+
+
+class ReplayShard:
+    """One replay storage shard: columnar ring + spill log + checkpoints,
+    served over a REP socket.
+
+    Params
+    ------
+    address: str
+        Endpoint to bind.  ``tcp://host:*`` binds an ephemeral port;
+        the resolved endpoint is available as :attr:`address`.
+    capacity: int
+        Ring slots this shard owns.
+    shard_id: int
+        Identity reported in ``hello`` replies and used in on-disk
+        names (``shard_{id:02d}.*``).
+    data_dir: str | None
+        Durability root.  None disables both tiers (a pure in-memory
+        shard — fine for benchmarks, no crash recovery).
+    checkpoint_every: int
+        Auto-checkpoint after this many appends since the last one
+        (0 = only on explicit ``save`` RPCs).  The spill log rotates at
+        every checkpoint, so recovery replays a bounded tail.
+    counters: EventCounters | None
+        Sink for ``record_drops`` etc.; defaults to the process-wide
+        ``fleet_counters``.
+    """
+
+    def __init__(self, address, capacity, *, shard_id=0, data_dir=None,
+                 checkpoint_every=0, counters=None, context=None):
+        import zmq
+
+        self.shard_id = int(shard_id)
+        self.capacity = int(capacity)
+        self.data_dir = data_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.counters = counters if counters is not None else fleet_counters
+        self.store = ColumnStore(self.capacity)
+        #: total rows ever accepted (the durability cursor: checkpoint
+        #: meta and spill records carry it, restore resumes from it)
+        self.seq = 0
+        self._last_ckpt_seq = 0
+        self.restored_from = None  # (ckpt_seq, tail_records) after restore
+        self._spill = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self._restore_from_disk()
+            self._open_spill()
+        self._reply_cache = OrderedDict()  # mid -> reply (mutating cmds)
+        self._ctx = context or zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if address.endswith(":*") or address.endswith(":0"):
+            base = address.rsplit(":", 1)[0]
+            port = self._sock.bind_to_random_port(base)
+            self.address = f"{base}:{port}"
+        else:
+            self._sock.bind(address)
+            self.address = address
+
+    # -- durability ----------------------------------------------------------
+
+    def _ckpt_path(self):
+        return os.path.join(
+            self.data_dir, f"shard_{self.shard_id:02d}.ckpt.npz"
+        )
+
+    def _spill_paths(self):
+        return sorted(glob.glob(os.path.join(
+            self.data_dir, f"shard_{self.shard_id:02d}.spill-*.btr"
+        )))
+
+    def _open_spill(self):
+        path = os.path.join(
+            self.data_dir,
+            f"shard_{self.shard_id:02d}.spill-{self.seq:012d}.btr",
+        )
+        # header cost is 8 bytes per slot at open AND close: size the
+        # file to its actual rotation interval instead of a worst case
+        cap = (
+            max(1024, 4 * self.checkpoint_every)
+            if self.checkpoint_every > 0 else SPILL_CAPACITY
+        )
+        self._spill = FileRecorder(
+            path, max_messages=cap, counters=self.counters
+        ).__enter__()
+
+    def _restore_from_disk(self):
+        """Latest checkpoint + spill tail -> exact pre-crash contents."""
+        from blendjax.utils.checkpoint import load_state
+
+        ckpt = self._ckpt_path()
+        if os.path.exists(ckpt):
+            arrays, meta = load_state(ckpt)
+            if meta.get("format") != SHARD_FORMAT:
+                raise ValueError(
+                    f"{ckpt} is not a replay shard checkpoint "
+                    f"(format {meta.get('format')!r})"
+                )
+            if int(meta["capacity"]) != self.capacity:
+                raise ValueError(
+                    f"shard {self.shard_id}: checkpoint capacity "
+                    f"{meta['capacity']} != configured {self.capacity}"
+                )
+            self.store.load_state_arrays(arrays)
+            self.seq = int(meta["seq"])
+            self._last_ckpt_seq = self.seq
+        tail = 0
+        for path in self._spill_paths():
+            # scan, never FileReader: a killed shard's spill has an
+            # unfinalized header, and the tail past the checkpoint is
+            # exactly the data a crash would otherwise lose
+            for rec in scan_messages(path):
+                if int(rec["seq"]) <= self.seq:
+                    continue  # covered by the checkpoint
+                self.store.write_row(int(rec["slot"]), rec["row"])
+                self.seq = int(rec["seq"])
+                tail += 1
+        if os.path.exists(ckpt) or tail:
+            self.restored_from = (self._last_ckpt_seq, tail)
+            logger.info(
+                "replay shard %d restored: checkpoint seq %d + %d spill-"
+                "tail rows -> seq %d", self.shard_id, self._last_ckpt_seq,
+                tail, self.seq,
+            )
+
+    def checkpoint(self):
+        """Atomic snapshot of the columns + seq cursor, then spill-log
+        rotation (old spills are fully covered by the snapshot and
+        deleted; a crash between the two steps is safe — restore skips
+        spill records at or below the checkpoint seq)."""
+        if self.data_dir is None:
+            return None
+        from blendjax.utils.checkpoint import save_state
+
+        path = self._ckpt_path()
+        save_state(
+            path, dict(self.store.state_arrays()),
+            {
+                "format": SHARD_FORMAT,
+                "shard_id": self.shard_id,
+                "capacity": self.capacity,
+                "seq": self.seq,
+            },
+        )
+        self._last_ckpt_seq = self.seq
+        if self._spill is not None:
+            self._spill.__exit__(None, None, None)
+        for old in self._spill_paths():
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+        self._open_spill()
+        return path
+
+    # -- request handling ----------------------------------------------------
+
+    def handle(self, msg):
+        """Dispatch one decoded request dict -> reply dict (correlation
+        id echoed; retried mutating requests served from the reply
+        cache — exactly-once at the storage level)."""
+        mid = msg.get(wire.BTMID_KEY)
+        cmd = msg.get("cmd")
+        if mid is not None and cmd in ("append", "save") \
+                and mid in self._reply_cache:
+            return self._reply_cache[mid]
+        try:
+            reply = getattr(self, f"_cmd_{cmd}", self._cmd_unknown)(msg)
+        except Exception as exc:  # noqa: BLE001 - surfaced to the client
+            logger.exception(
+                "replay shard %d: %r failed", self.shard_id, cmd
+            )
+            reply = {"error": f"{type(exc).__name__}: {exc}"}
+        if mid is not None:
+            reply[wire.BTMID_KEY] = mid
+            if cmd in ("append", "save"):
+                self._reply_cache[mid] = reply
+                while len(self._reply_cache) > wire.REPLY_CACHE_DEPTH:
+                    self._reply_cache.popitem(last=False)
+        return reply
+
+    def _cmd_unknown(self, msg):
+        raise ValueError(f"unknown replay shard command {msg.get('cmd')!r}")
+
+    def _cmd_hello(self, msg):
+        return {
+            "shard_id": self.shard_id,
+            "capacity": self.capacity,
+            "seq": self.seq,
+            "keys": list(self.store.keys),
+            "restored_from": self.restored_from,
+        }
+
+    def _cmd_append(self, msg):
+        slots = msg["slots"]
+        rows = msg["rows"]
+        if len(slots) != len(rows):
+            raise ValueError(
+                f"append: {len(slots)} slots vs {len(rows)} rows"
+            )
+        for slot, row in zip(slots, rows):
+            self.store.write_row(int(slot), row)
+            self.seq += 1
+            if self._spill is not None:
+                rec = {"slot": int(slot), "seq": self.seq, "row": row}
+                if not self._spill.save(rec):
+                    # spill at capacity: the ack below promises this row
+                    # survives a crash, so roll a checkpoint (which
+                    # rotates to a fresh spill) instead of dropping
+                    self.checkpoint()
+                    if not self._spill.save(rec):
+                        raise RuntimeError(
+                            f"shard {self.shard_id}: spill refused a "
+                            "record even after rotation"
+                        )
+        if self._spill is not None:
+            # durability point: the ack promises crash-exact recovery,
+            # so the spill bytes must reach the OS before the reply does
+            self._spill.flush()
+        if self.checkpoint_every > 0 and \
+                self.seq - self._last_ckpt_seq >= self.checkpoint_every:
+            self.checkpoint()
+        return {"seq": self.seq}
+
+    def _cmd_gather(self, msg):
+        indices = np.asarray(msg["indices"], np.int64)
+        keys = msg.get("keys")
+        data = self.store.gather(indices, keys=keys)
+        return {"data": data, "seq": self.seq}
+
+    def _cmd_stats(self, msg):
+        return {
+            "shard_id": self.shard_id,
+            "capacity": self.capacity,
+            "seq": self.seq,
+            "nbytes": self.store.nbytes,
+            "keys": list(self.store.keys),
+            "last_checkpoint_seq": self._last_ckpt_seq,
+            "spill_dropped": (
+                self._spill.dropped if self._spill is not None else 0
+            ),
+        }
+
+    def _cmd_save(self, msg):
+        path = self.checkpoint()
+        return {"path": path, "seq": self.seq}
+
+    # -- serving -------------------------------------------------------------
+
+    def serve_forever(self, stop_event=None, poll_ms=100):
+        """REP loop until ``stop_event`` (or :meth:`close`).  One request
+        == one reply; raw-buffer replies keep image gathers off the
+        pickle path."""
+        import zmq
+
+        while stop_event is None or not stop_event.is_set():
+            try:
+                if not self._sock.poll(poll_ms, zmq.POLLIN):
+                    continue
+                msg = wire.recv_message(self._sock)
+            except zmq.ZMQError:
+                return  # socket closed under us: clean shutdown
+            reply = self.handle(msg)
+            try:
+                wire.send_message(self._sock, reply, raw_buffers=True)
+            except zmq.ZMQError:
+                return
+
+    def close(self):
+        try:
+            self._sock.close(0)
+        except Exception:  # noqa: BLE001 - shutdown best-effort
+            pass
+        if self._spill is not None:
+            try:
+                self._spill.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
+            self._spill = None
+
+
+class _LocalShardHandle:
+    """An in-process shard server (thread) for tests and benchmarks."""
+
+    def __init__(self, shard, thread, stop):
+        self.shard = shard
+        self.address = shard.address
+        self._thread = thread
+        self._stop = stop
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.shard.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_shard_thread(capacity, *, shard_id=0, data_dir=None,
+                       checkpoint_every=0, address="tcp://127.0.0.1:*",
+                       counters=None):
+    """Serve a :class:`ReplayShard` from a daemon thread; returns a
+    handle with ``.address`` and ``.close()``.  Same wire surface as a
+    shard process — the benchmark's service windows and most service
+    tests run on these."""
+    shard = ReplayShard(
+        address, capacity, shard_id=shard_id, data_dir=data_dir,
+        checkpoint_every=checkpoint_every, counters=counters,
+    )
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=shard.serve_forever, kwargs={"stop_event": stop},
+        daemon=True, name=f"bjx-replay-shard-{shard_id}",
+    )
+    thread.start()
+    return _LocalShardHandle(shard, thread, stop)
+
+
+class _ShardLaunchInfo:
+    """Duck-typed ``launch_info`` so :class:`~blendjax.btt.watchdog.
+    FleetWatchdog` / :class:`~blendjax.btt.supervise.FleetSupervisor`
+    supervise shard processes exactly like Blender producers."""
+
+    def __init__(self, processes, addresses):
+        self.processes = processes
+        self.addresses = {"REPLAY": addresses}
+
+
+class ShardFleet:
+    """N replay shard *processes* with a launcher-compatible surface.
+
+    Each shard binds ``tcp://127.0.0.1:<port_i>``, persists under
+    ``data_dir`` and is spawned in its own session (so
+    :func:`blendjax.btt.chaos.kill_instance` kills the shard, not the
+    test).  ``respawn(idx)`` relaunches the same command line — the
+    restarted process restores its checkpoint + spill tail on its own —
+    which is what ``FleetSupervisor(restart=True)`` calls after a death.
+
+    Usage::
+
+        with ShardFleet(3, capacity_per_shard=4096, data_dir=d) as fleet:
+            sharded = ShardedReplay(fleet.addresses, seed=0)
+            sup = FleetSupervisor(fleet, pool=None, replay=sharded,
+                                  counters=sharded.counters)
+    """
+
+    def __init__(self, num_shards, capacity_per_shard, data_dir, *,
+                 checkpoint_every=1024, python=None, ready_timeout=30.0):
+        if num_shards < 1 or capacity_per_shard < 1:
+            raise ValueError(
+                "num_shards and capacity_per_shard must be >= 1"
+            )
+        self.num_shards = int(num_shards)
+        self.capacity_per_shard = int(capacity_per_shard)
+        self.data_dir = data_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.python = python or sys.executable
+        self.ready_timeout = ready_timeout
+        self.addresses = []
+        self.launch_info = None
+        self._cmds = []
+
+    def _spawn(self, cmd):
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    def __enter__(self):
+        from blendjax.replay.shard_client import free_port
+
+        os.makedirs(self.data_dir, exist_ok=True)
+        procs = []
+        try:
+            for i in range(self.num_shards):
+                addr = f"tcp://127.0.0.1:{free_port()}"
+                cmd = [
+                    self.python, "-m", "blendjax.replay.service",
+                    "--address", addr,
+                    "--capacity", str(self.capacity_per_shard),
+                    "--shard-id", str(i),
+                    "--dir", str(self.data_dir),
+                    "--checkpoint-every", str(self.checkpoint_every),
+                ]
+                procs.append(self._spawn(cmd))
+                self.addresses.append(addr)
+                self._cmds.append(cmd)
+            self.launch_info = _ShardLaunchInfo(procs, self.addresses)
+            self.wait_ready(self.ready_timeout)
+        except BaseException:
+            self.launch_info = _ShardLaunchInfo(procs, self.addresses)
+            self.close()
+            raise
+        return self
+
+    def wait_ready(self, timeout=30.0):
+        """Block until every shard answers ``hello`` — the deterministic
+        startup barrier (counters measured after it reflect injected
+        faults only, never shard boot time)."""
+        from blendjax.replay.shard_client import ShardClient
+
+        deadline = time.monotonic() + timeout
+        for i, addr in enumerate(self.addresses):
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"replay shard {i} at {addr} not ready within "
+                        f"{timeout:.1f}s"
+                    )
+                client = ShardClient(addr, i, timeoutms=500)
+                try:
+                    client.rpc("hello", timeout_ms=500)
+                    break
+                except TimeoutError:
+                    continue
+                finally:
+                    client.close()
+
+    def respawn(self, idx):
+        """Relaunch shard ``idx`` with its original command line (the
+        watchdog's contract).  The fresh process restores checkpoint +
+        spill tail from ``data_dir`` before serving."""
+        proc = self._spawn(self._cmds[idx])
+        self.launch_info.processes[idx] = proc
+        return proc
+
+    def close(self):
+        info = self.launch_info
+        if info is None:
+            return
+        for p in info.processes:
+            try:
+                p.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in info.processes:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Serve one blendjax replay storage shard."
+    )
+    ap.add_argument("--address", required=True,
+                    help="endpoint to bind, e.g. tcp://127.0.0.1:23000")
+    ap.add_argument("--capacity", type=int, required=True)
+    ap.add_argument("--shard-id", type=int, default=0)
+    ap.add_argument("--dir", default=None,
+                    help="durability root (checkpoints + .btr spill)")
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    shard = ReplayShard(
+        args.address, args.capacity, shard_id=args.shard_id,
+        data_dir=args.dir, checkpoint_every=args.checkpoint_every,
+    )
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    logger.info(
+        "replay shard %d serving %s (capacity %d, dir %s)",
+        args.shard_id, shard.address, args.capacity, args.dir,
+    )
+    try:
+        shard.serve_forever(stop_event=stop)
+    finally:
+        shard.close()
+
+
+if __name__ == "__main__":
+    main()

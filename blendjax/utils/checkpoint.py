@@ -18,12 +18,18 @@ from __future__ import annotations
 
 import os
 
-import jax
 import numpy as np
+
+# jax is imported lazily inside the pytree helpers: the array-state half
+# (save_state/load_state) is pure numpy, and its consumers now include
+# jax-free processes (the replay shard service, which checkpoints its
+# columns from a process that must start fast and never dial a device).
 
 
 def save_pytree(path, tree):
     """Serialize a pytree of arrays to ``path`` (.npz, atomic rename)."""
+    import jax
+
     leaves = jax.tree_util.tree_leaves(tree)
     arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     tmp = f"{path}.tmp"
@@ -38,6 +44,8 @@ def load_pytree(path, target):
     ``target`` supplies the treedef (e.g. a freshly-initialized TrainState);
     leaf count, shapes, and dtypes must match the checkpoint.
     """
+    import jax
+
     leaves, treedef = jax.tree_util.tree_flatten(target)
     with np.load(path) as data:
         if len(data.files) != len(leaves):
@@ -174,6 +182,8 @@ class CheckpointManager:
         if self.backend == "npz":
             save_pytree(path, state)
         else:
+            import jax
+
             self._ckptr.save(path, jax.tree.map(lambda x: x, state), force=True)
         self._retain()
         return path
@@ -190,6 +200,8 @@ class CheckpointManager:
         path = self._path(step)
         if self.backend == "npz":
             return load_pytree(path, template)
+        import jax
+
         restored = self._ckptr.restore(path, item=template)
         leaves, treedef = jax.tree_util.tree_flatten(template)
         new_leaves = jax.tree_util.tree_leaves(restored)

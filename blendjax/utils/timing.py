@@ -77,9 +77,26 @@ FLEET_EVENTS = (
 #: ``replay_sample_waits`` — sample calls that blocked on an
 #: underfilled buffer (learner outpacing the actor);
 #: ``replay_priority_updates`` — update_priorities calls applied.
+#: ``replay_sample_skips`` — off-policy learner tail draws skipped
+#: because the buffer (or its live shards) could not serve the batch;
+#: sharded replay service (docs/replay.md "Sharded replay service"):
+#: ``replay_shard_quarantined`` — a shard stopped answering RPCs (or its
+#: process died) and was isolated; sampling renormalizes strata over the
+#: live shards and continues degraded;
+#: ``replay_shard_readmissions`` — a shard passed the re-admission
+#: handshake (restored checkpoint + ``.btr`` tail verified, journal
+#: flushed) and rejoined the draw domain;
+#: ``replay_shard_journal`` — appends owned by a quarantined shard held
+#: client-side (flushed on re-admission, never lost);
+#: ``replay_shard_lost`` — rows a restarted shard could not account for
+#: (it restored an older state than the client acked); their slots are
+#: invalidated instead of serving wrong rows.
 REPLAY_EVENTS = (
     "replay_appends", "replay_overwrites", "replay_excluded",
     "replay_samples", "replay_sample_waits", "replay_priority_updates",
+    "replay_sample_skips",
+    "replay_shard_quarantined", "replay_shard_readmissions",
+    "replay_shard_journal", "replay_shard_lost",
 )
 
 #: Canonical replay-path stage names (see docs/replay.md), the
@@ -88,8 +105,12 @@ REPLAY_EVENTS = (
 #: ring columns), ``sample_wait`` (blocked on an underfilled buffer),
 #: ``sample_gather`` (index draw + columnar gather into the batch),
 #: ``priority_update`` (sum-tree refresh after a learner step).
+#: The sharded service adds ``shard_append`` (one append RPC to a shard,
+#: wire + remote write + spill flush) and ``shard_gather`` (one gather
+#: RPC: wire + remote columnar read + client-side scatter).
 REPLAY_STAGES = (
     "replay_append", "sample_wait", "sample_gather", "priority_update",
+    "shard_append", "shard_gather",
 )
 
 

@@ -113,7 +113,10 @@ def _strip_arrays(obj, bufs: list):
         return {
             _ARRAY_PLACEHOLDER: len(bufs) - 1,
             "dtype": arr.dtype.str,
-            "shape": arr.shape,
+            # the ORIGINAL shape: ascontiguousarray promotes 0-d arrays
+            # to (1,), which would silently grow a rank on the receiver
+            # (a replay shard rejects the row as schema drift)
+            "shape": obj.shape,
         }
     if isinstance(obj, dict):
         return {k: _strip_arrays(v, bufs) for k, v in obj.items()}

@@ -263,6 +263,76 @@ class TestMultiFleetTraining:
         assert health["dead_fleets"] == [1]
         assert health["healthy_envs"] == 2 and health["num_envs"] == 4
 
+    @pytest.mark.chaos
+    def test_killed_fleet_rejoins_after_supervised_respawn(
+        self, fake_blender
+    ):
+        """Fleet re-admission: SIGKILL fleet 1's only producer so its
+        actor thread dies (all-dead pool raises) and the fleet is
+        zero-masked — then the supervisor respawns the producer and
+        heals the pool, and the learner must RESTART the fleet's actor
+        thread so it rejoins the fan-in: ``dead_fleets`` shrinks back
+        to empty and fleet 1 contributes env steps again after the
+        kill."""
+        from blendjax.btt.chaos import kill_instance
+        from blendjax.btt.faults import FaultPolicy
+
+        values = np.array([0.0, 1.0], np.float64)
+        policy = FaultPolicy(
+            max_retries=1, backoff_base=0.05, deadline_s=2.0,
+            circuit_threshold=0, seed=7,
+        )
+        with FleetSet(
+            "", ENV_SCRIPT, num_fleets=2, envs_per_fleet=1,
+            start_port=15400, timeoutms=10000, fault_policy=policy,
+            restart=True, interval=0.2, horizon=1_000_000,
+        ) as fs:
+            al = ActorLearner(
+                fs, obs_dim=1, num_actions=2, rollout_len=8, seed=1,
+                action_map=lambda a: list(values[np.asarray(a)]),
+            )
+            al.fleet_restart_cooldown = 0.2
+            marks = {}
+
+            def killer():
+                while min(al._env_steps_by_fleet) < 16:
+                    time.sleep(0.02)
+                marks["steps_at_kill"] = al._env_steps_by_fleet[1]
+                kill_instance(fs.launchers[1], 0)
+
+            result = {}
+
+            def runner():
+                result.update(al.run(num_updates=100_000, seconds=60))
+
+            kt = threading.Thread(target=killer, daemon=True)
+            rt = threading.Thread(target=runner, daemon=True)
+            rt.start()
+            kt.start()
+            kt.join(timeout=30)
+            assert "steps_at_kill" in marks, "fleets never started"
+            # wait (bounded) for the whole cycle: death -> respawn ->
+            # pool heal -> actor restart -> fleet producing again
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                if (al._fleet_restarts[1] >= 1
+                        and al._actor_errors[1] is None
+                        and al._env_steps_by_fleet[1]
+                        > marks["steps_at_kill"] + 8):
+                    break
+                time.sleep(0.1)
+            al._stop.set()  # end the run; the finally joins actors
+            rt.join(timeout=30)
+            health = fs.health()
+        assert result.get("fleet_restarts", [0, 0])[1] >= 1
+        assert result["dead_fleets"] == []  # the fleet REJOINED
+        assert result["env_steps_by_fleet"][1] > \
+            marks["steps_at_kill"] + 8
+        # the death/restart trail pins to fleet 1
+        assert health["fleets"][1]["deaths"] >= 1
+        assert health["fleets"][1]["restarts"] >= 1
+        assert health["fleets"][0]["deaths"] == 0
+
 
 class TestShardedReplay:
     def _filled_buffer(self, n=512, d=3):

@@ -1,0 +1,512 @@
+"""Sharded replay service tests (docs/replay.md "Sharded replay
+service"): draw-stream reproducibility across shard layouts and
+mid-stream save/restore, exactly-once shard RPCs, crash-exact shard
+recovery (checkpoint + ``.btr`` spill tail), quarantine + degraded
+sampling + journal flush, diagnosable errors, and the kill-one-shard
+chaos acceptance (SIGKILL a shard process mid-training -> degraded
+sampling -> supervised respawn -> re-admission with the global draw
+stream continuing bit-identically from its checkpoint)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from blendjax.btt.faults import FaultPolicy
+from blendjax.replay import ReplayBuffer, ShardedReplay, ShardRPCError
+from blendjax.replay.service import (
+    ReplayShard,
+    ShardFleet,
+    start_shard_thread,
+)
+from blendjax.utils.timing import EventCounters
+
+
+def _row(i, d=4):
+    """Deterministic transition keyed by its append index (bit-exact
+    content checks hang off this)."""
+    return {
+        "obs": np.full(d, i, np.float32),
+        "action": np.int32(i % 3),
+        "reward": np.float32(i % 7),
+        "done": bool(i % 11 == 0),
+    }
+
+
+def _fill(buf, n, start=0):
+    for i in range(start, start + n):
+        buf.append(_row(i))
+
+
+@pytest.fixture
+def shard4():
+    handles = [start_shard_thread(16, shard_id=i) for i in range(4)]
+    yield handles
+    for h in handles:
+        h.close()
+
+
+# -- shard server unit behavior ----------------------------------------------
+
+
+def test_shard_handle_append_retry_is_exactly_once():
+    """A retried append (same correlation id) is answered from the reply
+    cache: the rows are applied once, the seq cursor moves once."""
+    shard = ReplayShard("tcp://127.0.0.1:*", 8, shard_id=0)
+    try:
+        req = {"cmd": "append", "slots": [0],
+               "rows": [_row(1)], "btmid": "aa"}
+        r1 = shard.handle(dict(req))
+        r2 = shard.handle(dict(req))  # the retry
+        assert r1["seq"] == r2["seq"] == 1
+        assert shard.seq == 1
+        assert shard.store.read_row(0)["obs"][0] == 1.0
+        # a fresh id is a new request
+        r3 = shard.handle({"cmd": "append", "slots": [1],
+                           "rows": [_row(2)], "btmid": "bb"})
+        assert r3["seq"] == 2
+    finally:
+        shard.close()
+
+
+def test_shard_handle_errors_are_replies_not_crashes():
+    shard = ReplayShard("tcp://127.0.0.1:*", 8, shard_id=0)
+    try:
+        r = shard.handle({"cmd": "no-such-cmd", "btmid": "x"})
+        assert "error" in r and "no-such-cmd" in r["error"]
+        # the server keeps serving
+        assert shard.handle({"cmd": "hello"})["capacity"] == 8
+    finally:
+        shard.close()
+
+
+def test_shard_crash_exact_restore(tmp_path):
+    """Kill (abandon) a shard mid-stream: a fresh process restores the
+    checkpoint plus the unfinalized spill tail to the exact pre-crash
+    contents — every acked append survives."""
+    a = ReplayShard("tcp://127.0.0.1:*", 32, shard_id=0,
+                    data_dir=str(tmp_path), checkpoint_every=8)
+    for i in range(20):
+        a.handle({"cmd": "append", "slots": [i % 32],
+                  "rows": [_row(i)], "btmid": f"m{i}"})
+    assert a.seq == 20 and a._last_ckpt_seq == 16
+    a._sock.close(0)  # SIGKILL stand-in: no clean close, spill header
+    # stays unfinalized (all -1 offsets)
+    b = ReplayShard("tcp://127.0.0.1:*", 32, shard_id=0,
+                    data_dir=str(tmp_path))
+    try:
+        assert b.seq == 20
+        assert b.restored_from == (16, 4)  # ckpt seq + spill-tail rows
+        for i in range(20):
+            got = b.store.read_row(i % 32)
+            np.testing.assert_array_equal(got["obs"], _row(i)["obs"])
+    finally:
+        b.close()
+
+
+def test_shard_restore_survives_torn_spill_tail(tmp_path):
+    """A crash mid-write leaves a half-record at the spill's end; the
+    scan recovers everything before it instead of failing."""
+    a = ReplayShard("tcp://127.0.0.1:*", 16, shard_id=0,
+                    data_dir=str(tmp_path))
+    for i in range(6):
+        a.handle({"cmd": "append", "slots": [i],
+                  "rows": [_row(i)], "btmid": f"m{i}"})
+    a._sock.close(0)
+    spill = a._spill_paths()[0]
+    with open(spill, "r+b") as f:
+        f.truncate(os.path.getsize(spill) - 7)  # tear the last record
+    b = ReplayShard("tcp://127.0.0.1:*", 16, shard_id=0,
+                    data_dir=str(tmp_path))
+    try:
+        assert b.seq == 5  # the torn 6th record is gone, 5 survive
+        assert b.store.read_row(4)["obs"][0] == 4.0
+    finally:
+        b.close()
+
+
+# -- draw-stream reproducibility (satellite) ----------------------------------
+
+
+def test_draw_stream_identical_across_shard_layouts(shard4):
+    """Same seed -> bit-identical sample streams for the 1-shard layout,
+    the 4-shard layout, and the in-process ReplayBuffer, through
+    appends, priority updates, and wraparound — the client is the draw
+    authority, so the layout cannot leak into the stream."""
+    h1 = start_shard_thread(64, shard_id=0)
+    try:
+        one = ShardedReplay([h1.address], seed=5)
+        four = ShardedReplay([h.address for h in shard4], seed=5)
+        ref = ReplayBuffer(64, seed=5)
+        bufs = (one, four, ref)
+        for b in bufs:
+            _fill(b, 80)  # wraps the 64-slot ring
+        for _ in range(6):
+            draws = [b.sample(8) for b in bufs]
+            (d0, i0, w0) = draws[0]
+            for data, idx, w in draws[1:]:
+                np.testing.assert_array_equal(idx, i0)
+                np.testing.assert_array_equal(w, w0)
+                for key in d0:
+                    np.testing.assert_array_equal(data[key], d0[key])
+            prios = np.abs(
+                np.asarray(d0["reward"], np.float64) - 3.0
+            )
+            for b, (_, idx, _w) in zip(bufs, draws):
+                b.update_priorities(idx, prios)
+            for b in bufs:
+                _fill(b, 4, start=1000)
+    finally:
+        h1.close()
+
+
+def test_stream_continues_across_mid_stream_save_restore(tmp_path):
+    """save() checkpoints the sampling authority + snapshots every
+    shard; restoring the pair — including restarting the shards from
+    disk — continues the exact draw stream and serves bit-identical
+    rows."""
+    handles = [
+        start_shard_thread(32, shard_id=i, data_dir=str(tmp_path))
+        for i in range(2)
+    ]
+    try:
+        buf = ShardedReplay([h.address for h in handles], seed=9)
+        _fill(buf, 50)
+        for _ in range(3):
+            buf.sample(8)
+        ck = str(tmp_path / "client.npz")
+        buf.save(ck)
+        expected = [buf.sample(8) for _ in range(5)]
+    finally:
+        for h in handles:
+            h.close()
+    # cold restart: fresh shard servers restore from disk, then the
+    # client restores its checkpoint over them
+    handles = [
+        start_shard_thread(32, shard_id=i, data_dir=str(tmp_path))
+        for i in range(2)
+    ]
+    try:
+        ref = ShardedReplay.restore(ck, [h.address for h in handles])
+        for data, idx, w in expected:
+            d2, i2, w2 = ref.sample(8)
+            np.testing.assert_array_equal(i2, idx)
+            np.testing.assert_array_equal(w2, w)
+            for key in data:
+                np.testing.assert_array_equal(d2[key], data[key])
+    finally:
+        for h in handles:
+            h.close()
+
+
+def test_restore_refuses_mismatched_shard_state(tmp_path):
+    """A shard whose durability cursor disagrees with the checkpoint
+    would serve rows the draw state does not describe — restore raises
+    instead of sampling ghosts."""
+    handles = [start_shard_thread(16, shard_id=i, data_dir=str(tmp_path))
+               for i in range(2)]
+    try:
+        buf = ShardedReplay([h.address for h in handles], seed=1)
+        _fill(buf, 20)
+        ck = str(tmp_path / "client.npz")
+        buf.save(ck)
+        _fill(buf, 5, start=100)  # shards move past the checkpoint
+        with pytest.raises(RuntimeError, match="seq"):
+            ShardedReplay.restore(ck, [h.address for h in handles])
+    finally:
+        for h in handles:
+            h.close()
+
+
+# -- quarantine / degraded sampling / journal ---------------------------------
+
+
+def test_quarantine_degraded_sampling_journal_and_readmission(shard4):
+    counters = EventCounters()
+    buf = ShardedReplay(
+        [h.address for h in shard4], seed=3, counters=counters
+    )
+    _fill(buf, 60)
+    buf.quarantine_shard(1, reason="test")
+    assert list(buf.quarantined) == [False, True, False, False]
+    assert counters.get("replay_shard_quarantined") == 1
+    lo, hi = 16, 32
+    for _ in range(6):
+        data, idx, w = buf.sample(8)
+        assert not ((idx >= lo) & (idx < hi)).any(), idx
+        # weights renormalized over the LIVE mass, still max-1
+        assert w.max() == pytest.approx(1.0)
+    # appends whose slot lands in the dead shard journal client-side
+    _fill(buf, 40, start=60)  # head wraps through shard 1's range
+    st = buf.stats()["shards"]
+    assert st["journal_pending"] > 0
+    assert counters.get("replay_shard_journal") == st["journal_pending"]
+    # re-admission flushes the journal and restores the draw domain
+    assert buf.probe()
+    st = buf.stats()["shards"]
+    assert st["quarantined"] == [] and st["journal_pending"] == 0
+    assert counters.get("replay_shard_readmissions") == 1
+    # the flushed rows are served bit-identically from the shard
+    got = buf.get(20)  # slot 20 was overwritten by append 84 (64+20)
+    np.testing.assert_array_equal(got["obs"], _row(84)["obs"])
+    seen = set()
+    for _ in range(20):
+        _, idx, _ = buf.sample(8)
+        seen.update(int(i) for i in idx)
+    assert any(lo <= i < hi for i in seen), "re-admitted range never drawn"
+
+
+def test_degraded_draws_follow_renormalized_priorities_non_pow2():
+    """Degraded sampling must track the live priority distribution for
+    NON-power-of-2 capacities too: the sum tree's prefix order is a
+    rotation of slot order there, so any routing that reuses the tree's
+    mass domain mis-lands draws — the cumulative-mass draw must not."""
+    handles = [start_shard_thread(12, shard_id=i) for i in range(3)]
+    try:
+        buf = ShardedReplay([h.address for h in handles], seed=7)
+        assert buf.capacity == 36  # not a power of two
+        _fill(buf, 36)
+        # one live row carries ~all the mass; the dead shard holds none
+        hot = 30  # shard 2
+        buf.update_priorities(np.arange(36), np.full(36, 1e-6))
+        # slots never drawn accept direct sets; make one dominant
+        buf.tree.set(hot, buf.tree.total * 1e6)
+        buf.quarantine_shard(0, reason="test")
+        counts = {}
+        for _ in range(10):
+            _, idx, w = buf.sample(8)
+            assert not (idx < 12).any(), idx  # dead range avoided
+            for i in idx:
+                counts[int(i)] = counts.get(int(i), 0) + 1
+        assert counts.get(hot, 0) >= 0.9 * sum(counts.values()), counts
+    finally:
+        for h in handles:
+            h.close()
+
+
+def test_gather_failure_mid_sample_quarantines_and_redraws(shard4):
+    """A shard dying between draw and gather: the sample call quarantines
+    it and redraws over the survivors instead of failing the learner."""
+    policy = FaultPolicy(max_retries=0, circuit_threshold=0, seed=1)
+    buf = ShardedReplay(
+        [h.address for h in shard4], seed=3, fault_policy=policy,
+        timeoutms=300,
+    )
+    _fill(buf, 64)
+    shard4[2].close()  # silently stop serving (no death notification)
+    data, idx, w = buf.sample(8)  # must succeed degraded
+    assert not ((idx >= 32) & (idx < 48)).any()
+    assert list(buf.quarantined) == [False, False, True, False]
+    # a permanently dead shard stays quarantined: probe returns False
+    assert not buf.probe(block_ms=100)
+
+
+def test_all_shards_dead_raises_diagnosable_timeout():
+    h = start_shard_thread(16, shard_id=0)
+    policy = FaultPolicy(max_retries=0, circuit_threshold=0, seed=1)
+    buf = ShardedReplay([h.address], seed=0, fault_policy=policy,
+                        timeoutms=200, name="svc-replay")
+    _fill(buf, 10)
+    h.close()
+    with pytest.raises(TimeoutError) as ei:
+        buf.sample(4)
+    msg = str(ei.value)
+    assert "svc-replay" in msg          # names the buffer
+    assert "shard" in msg               # pins the shard
+    assert "eligible" in msg            # embeds the stats digest
+    assert isinstance(ei.value, TimeoutError)  # learner tail skips it
+
+
+def test_exactly_once_through_lossy_wire(shard4):
+    """Stall the wire so the first attempt times out and is retried:
+    both copies eventually arrive, the shard applies the append ONCE
+    (reply cache keyed by the correlation id)."""
+    from blendjax.btt.chaos import ChaosProxy
+
+    with ChaosProxy(shard4[0].address) as proxy:
+        policy = FaultPolicy(
+            max_retries=2, backoff_base=0.01, backoff_max=0.05,
+            circuit_threshold=0, seed=2,
+        )
+        buf = ShardedReplay(
+            [proxy.address], seed=0, fault_policy=policy, timeoutms=250,
+        )
+        _fill(buf, 4)
+        base_seq = buf.stats()["shards"]["acked"][0]
+        proxy.stall()
+        done = {}
+
+        def appender():
+            buf.append(_row(99))
+            done["ok"] = True
+
+        t = threading.Thread(target=appender, daemon=True)
+        t.start()
+        time.sleep(0.4)  # first attempt times out, a retry is queued
+        proxy.resume()
+        t.join(timeout=10)
+        assert done.get("ok")
+        # exactly one row landed despite two request copies on the wire
+        hello = shard4[0].shard.handle({"cmd": "hello"})
+        assert hello["seq"] == base_seq + 1
+        assert buf.stats()["shards"]["acked"][0] == base_seq + 1
+        buf.close()
+
+
+# -- error diagnosability (satellite) -----------------------------------------
+
+
+def test_underfill_and_arena_errors_name_buffer_and_embed_stats():
+    from blendjax.btt.arena import ArenaPool
+
+    buf = ReplayBuffer(32, seed=0, name="tiny-replay")
+    buf.append(_row(0))
+    with pytest.raises(TimeoutError) as ei:
+        buf.sample(8, timeout=0.05)
+    msg = str(ei.value)
+    assert "tiny-replay" in msg and "size=1/32" in msg \
+        and "eligible=1" in msg
+    # arena exhaustion: a pool whose only arena is held hostage
+    _fill(buf, 20)
+    pool = ArenaPool(pool_size=1)
+    hostage = pool.acquire()
+    assert hostage is not None
+    gen = buf.sample_batches(4, arena_pool=pool, timeout=0.1)
+    with pytest.raises(TimeoutError) as ei:
+        next(gen)
+    msg = str(ei.value)
+    assert "tiny-replay" in msg and "pool size 1" in msg \
+        and "appends=21" in msg
+
+
+# -- learner transparency ------------------------------------------------------
+
+
+def test_run_offline_accepts_sharded_replay(shard4):
+    """ActorLearner(replay=ShardedReplay) trains offline through the
+    arena + device_prefetch seam unchanged — the service is a drop-in
+    for the in-process buffer."""
+    from blendjax.models.actor_learner import ActorLearner
+
+    buf = ShardedReplay([h.address for h in shard4], seed=2)
+    rng = np.random.default_rng(0)
+    for i in range(60):
+        buf.append({
+            "obs": rng.random(3).astype(np.float32),
+            "action": np.int32(rng.integers(0, 2)),
+            "reward": np.float32(rng.random()),
+            "done": False,
+        })
+    al = ActorLearner(None, obs_dim=3, num_actions=2, seed=2, replay=buf)
+    out = al.run_offline(num_updates=3, batch_size=16)
+    assert out["updates"] == 3
+    assert all(np.isfinite(v) for v in out["losses"])
+    assert out["replay"]["shards"]["count"] == 4
+
+
+def test_sharded_bench_schema_and_degraded_overhead():
+    """The --sharded benchmark emits the locked schema with live ratios
+    (tiny frames so this stays a schema/plumbing test, not a perf
+    run)."""
+    from benchmarks._common import REPLAY_SHARD_KEYS
+    from benchmarks.replay_benchmark import measure_sharded
+
+    rec = measure_sharded(
+        width=16, height=12, channels=3, batch=8, capacity=256,
+        shards=2, seconds=1.0, seed=0,
+    )
+    assert all(k in rec for k in REPLAY_SHARD_KEYS)
+    assert rec["replay_shard_x"] is not None and rec["replay_shard_x"] > 0
+    assert rec["replay_degraded_x"] is not None \
+        and rec["replay_degraded_x"] > 0
+
+
+# -- the chaos acceptance ------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_kill_one_shard_degraded_then_crash_exact_readmission(tmp_path):
+    """THE storage-tier chaos acceptance (ISSUE 8): SIGKILL 1 of 3 shard
+    processes mid-training.  Sampling continues degraded (strata
+    renormalized over live shards, quarantine counters pinned to the
+    dead shard); the supervisor respawns the process, which restores
+    its checkpoint + ``.btr`` spill tail; re-admission brings the
+    pre-kill contents back bit-identically and the global draw stream
+    continues bit-identically from its checkpoint."""
+    from blendjax.btt.chaos import kill_instance
+    from blendjax.btt.supervise import FleetSupervisor
+
+    counters = EventCounters()
+    policy = FaultPolicy(
+        max_retries=1, backoff_base=0.02, backoff_max=0.1,
+        deadline_s=1.0, circuit_threshold=0, seed=3,
+    )
+    with ShardFleet(
+        3, capacity_per_shard=48, data_dir=str(tmp_path / "shards"),
+        checkpoint_every=20,
+    ) as fleet:
+        buf = ShardedReplay(
+            fleet.addresses, seed=5, fault_policy=policy,
+            counters=counters, timeoutms=1000,
+        )
+        with FleetSupervisor(
+            fleet, pool=None, interval=0.15, restart=True,
+            counters=counters, replay=buf, heal_interval=0.05,
+        ) as sup:
+            _fill(buf, 120)
+            for _ in range(3):
+                buf.sample(8)
+            lo, hi = 48, 96  # shard 1's global slot range
+            expected_rows = {
+                slot: buf.get(slot) for slot in range(lo, hi, 7)
+            }
+            kill_instance(fleet, 1)
+            assert sup.await_deaths(1, timeout=20)
+            # degraded: draws avoid the dead range, training continues
+            for _ in range(5):
+                data, idx, w = buf.sample(8)
+                assert not ((idx >= lo) & (idx < hi)).any(), idx
+            # counters pinned to the dead shard
+            assert counters.get("replay_shard_quarantined") >= 1
+            assert buf.stats()["shards"]["quarantined"] == [1]
+            h = sup.health()
+            assert h["deaths"] >= 1
+            assert h["replay"]["shards"]["quarantined"] == [1]
+            # supervised respawn -> crash-exact restore -> re-admission
+            assert sup.await_healthy(timeout=30), (
+                counters.snapshot(), buf.stats()
+            )
+            assert counters.get("replay_shard_readmissions") == 1
+            assert counters.get("replay_shard_lost") == 0
+            # pre-kill contents intact, bit for bit
+            for slot, row in expected_rows.items():
+                got = buf.get(slot)
+                for key in row:
+                    np.testing.assert_array_equal(got[key], row[key])
+            # the re-admitted range rejoins the draw domain
+            seen = set()
+            for _ in range(20):
+                _, idx, _ = buf.sample(8)
+                seen.update(int(i) for i in idx)
+            assert any(lo <= i < hi for i in seen)
+            # global draw stream continues bit-identically from its
+            # checkpoint: snapshot, keep drawing live, then restore the
+            # checkpoint into a fresh client over the same shards — the
+            # two streams must match draw for draw, byte for byte
+            ck = str(tmp_path / "client.npz")
+            buf.save(ck)
+            expected = [buf.sample(8) for _ in range(5)]
+            ref = ShardedReplay.restore(
+                ck, fleet.addresses, fault_policy=policy,
+                counters=EventCounters(), timeoutms=1000,
+            )
+            for data, idx, w in expected:
+                d2, i2, w2 = ref.sample(8)
+                np.testing.assert_array_equal(i2, idx)
+                np.testing.assert_array_equal(w2, w)
+                for key in data:
+                    np.testing.assert_array_equal(d2[key], data[key])
+            ref.close()
+        buf.close()
