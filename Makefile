@@ -10,7 +10,8 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
-	rlbench rlbench-sharded replaybench multichip dryrun
+	rlbench rlbench-sharded replaybench multichip dryrun benchdiff \
+	obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -36,8 +37,14 @@ tier1:
 # fault injection — proxy stall/drop/garble, producer SIGKILL, supervised
 # restart-and-resync.  Includes the `slow` soak cycles that tier-1 skips.
 # See docs/fault_tolerance.md.
+# BJX_POSTMORTEM_DIR: every supervised producer/shard death during the
+# chaos run dumps a flight-recorder postmortem JSON there (naming the
+# quarantined target and the fault events around it) — the chaos
+# failure is diagnosable from artifacts, not just exit codes.  See
+# docs/observability.md.
 chaos:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		BJX_POSTMORTEM_DIR=obs_artifacts \
 		$(PYTHON) -m pytest tests/ -m chaos -q -rs
 
 # The replay-service shard chaos pack (tests/test_replay_service.py):
@@ -48,6 +55,7 @@ chaos:
 # runnable alone for storage-tier work.  See docs/replay.md.
 chaos-replay:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		BJX_POSTMORTEM_DIR=obs_artifacts \
 		$(PYTHON) -m pytest tests/test_replay_service.py -m chaos -q -rs
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
@@ -126,6 +134,31 @@ multichip:
 replaybench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/replay_benchmark.py \
 		--batch 32 --seconds 6 --sharded
+
+# Bench-trajectory guardrail (docs/observability.md): diff two bench
+# artifacts with per-metric regression floors; non-zero exit on any
+# metric below its floor.  Accepts raw bench.py stdout, headline lines,
+# and the driver capture wrappers (BENCH_r0x.json).
+#   make benchdiff OLD=BENCH_r05.json NEW=BENCH_new.json
+OLD ?= BENCH_r05.json
+NEW ?= BENCH_new.json
+benchdiff:
+	$(PYTHON) scripts/bench_compare.py $(OLD) $(NEW)
+
+# Telemetry-plane demo (docs/observability.md): a short fake-Blender
+# pipeline with tracing on, emitting into obs_artifacts/ —
+#   trace.perfetto.json  one merged Chrome/Perfetto timeline with
+#                        producer- and consumer-side spans of the same
+#                        correlation ids across >= 3 pids,
+#   scrape.json/.prom    a TelemetryHub scrape (zero-filled canonical
+#                        counters+stages, latency percentiles) in both
+#                        exposition formats, pulled over the ZMQ REP
+#                        scrape socket,
+#   postmortem-*.json    a forced flight-recorder dump naming a
+#                        quarantined target.
+obsdemo:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) scripts/obs_demo.py --out obs_artifacts
 
 dryrun:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \

@@ -401,6 +401,7 @@ HEADLINE_ABBREV = (
 #: partial/degraded markers are never dropped.
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
+    ("telemetry_overhead_x",),
     ("replay_shard_x", "replay_degraded_x"),
     ("rl_sharded_x",),
     ("replay_sample_x",),
@@ -425,6 +426,10 @@ def headline(out):
     if fb and fb.get("arena_over_legacy") is not None:
         # arena assembly speedup over legacy collate at the feed ceiling
         line["feed_arena_x"] = fb["arena_over_legacy"]
+    if fb and fb.get("telemetry_overhead_x") is not None:
+        # telemetry-plane sanity: feed throughput with hub+histograms
+        # enabled over disabled (floor 0.95 — see docs/observability.md)
+        line["telemetry_overhead_x"] = fb["telemetry_overhead_x"]
     rb = out.get("replay_bench")
     if rb and rb.get("replay_sample_x") is not None:
         # columnar batched replay sampling speedup over naive per-item

@@ -21,6 +21,8 @@ Subpackages
 - ``blendjax.ops``     image ops (sRGB decode, normalize, augment) incl. a
   Pallas TPU kernel for the hot uint8->bf16 path.
 - ``blendjax.parallel`` mesh/sharding helpers and the vectorized env pool.
+- ``blendjax.obs``     unified telemetry plane: latency histograms,
+  cross-process trace spans, TelemetryHub scrapes, flight recorders.
 - ``blendjax.utils``    timing/tracing, logging.
 
 This module is import-light on purpose: importing :mod:`blendjax` pulls in
@@ -32,7 +34,9 @@ __version__ = "0.1.0"
 
 from blendjax import wire  # noqa: F401  (pure stdlib + zmq/numpy, always safe)
 
-_SUBMODULES = ("btt", "btb", "models", "ops", "parallel", "utils", "wire")
+_SUBMODULES = (
+    "btt", "btb", "models", "obs", "ops", "parallel", "utils", "wire",
+)
 
 
 def __getattr__(name):  # PEP 562 lazy subpackage access

@@ -26,6 +26,16 @@ consumer-side retry of a non-idempotent ``step`` becomes exactly-once
 (see the caveat in :mod:`blendjax.btt.faults`).  Unstamped requests
 (reference consumers) behave exactly as before.
 
+Requests additionally carrying a span context (``wire.SPAN_KEY`` — a
+tracing ``EnvPool``) get a producer-side trace span back, piggybacked
+on the reply under ``wire.SPANS_KEY``: one ``producer_step`` span
+covering request-receipt through reply-send — i.e. the frame's physics
++ render inside Blender's animation loop — tagged with the request's
+correlation id, so the consumer's merged Perfetto timeline shows the
+producer's share of every env step in its own process row (see
+:mod:`blendjax.obs.spans` and docs/observability.md).  Span-less
+requests pay nothing.
+
 Module import needs no bpy; only instantiating ``BaseEnv`` touches the
 animation system, so the RPC state machine is unit-testable in CI.
 """
@@ -195,6 +205,10 @@ class RemoteControlledAgent:
         self._pending_mid = None
         self._reply_cache = OrderedDict()
         self._dup_reply = None  # cached reply owed after a NOBLOCK Again
+        # span context of the request being simulated: (trace id,
+        # receipt time in epoch us); rides into the reply as a
+        # producer-side span when the request asked for one
+        self._pending_span = None
 
     def __call__(self, env, **ctx):
         flags = 0
@@ -218,6 +232,14 @@ class RemoteControlledAgent:
             reply = ctx
             if self._pending_mid is not None:
                 reply = {**ctx, wire.BTMID_KEY: self._pending_mid}
+            if self._pending_span is not None:
+                from blendjax.obs.spans import make_span
+
+                trace, t0_us = self._pending_span
+                reply = dict(reply)
+                reply[wire.SPANS_KEY] = [make_span(
+                    "producer_step", t0_us, trace=trace, cat="producer",
+                )]
             try:
                 wire.send_message(self.socket, reply, flags=flags)
                 self.state = RemoteControlledAgent.STATE_REQ
@@ -226,6 +248,7 @@ class RemoteControlledAgent:
                     while len(self._reply_cache) > self.REPLY_CACHE_DEPTH:
                         self._reply_cache.popitem(last=False)
                     self._pending_mid = None
+                self._pending_span = None
             except zmq.Again:
                 if not self.real_time:
                     raise TimeoutError("Failed to send reply to remote agent.")
@@ -263,6 +286,13 @@ class RemoteControlledAgent:
             raise ValueError(f"unknown remote command {cmd_name!r}")
         self.state = RemoteControlledAgent.STATE_REP
         self._pending_mid = mid
+        span_ctx = request.get(wire.SPAN_KEY)
+        if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
+            from blendjax.obs.spans import now_us
+
+            self._pending_span = (span_ctx["trace"], now_us())
+        else:
+            self._pending_span = None
 
         if cmd_name == "reset":
             if env.state == BaseEnv.STATE_INIT:

@@ -61,6 +61,8 @@ from blendjax.btt.collate import collate
 from blendjax.btt.constants import DEFAULT_TIMEOUTMS
 from blendjax.btt.env import kwargs_to_cli
 from blendjax.btt.faults import FaultPolicy
+from blendjax.obs.flight import flight_recorder
+from blendjax.obs.spans import SpanRecorder, make_span, now_us
 from blendjax.utils.timing import fleet_counters
 
 logger = logging.getLogger("blendjax")
@@ -133,6 +135,17 @@ class EnvPool:
         Maximum requests in flight per env on the async
         ``step_async``/``step_wait`` path (>= 1).  Lock-step ``step()``
         ignores it.
+    trace: bool
+        Record cross-process trace spans (docs/observability.md): every
+        RPC gets a client-side span in :attr:`spans` tagged with its
+        ``wire.BTMID_KEY`` correlation id, requests carry a span
+        context, and producer-side spans piggybacked on replies are
+        ingested into the same recorder — one
+        ``spans.export_chrome_trace(path)`` yields the merged
+        multi-pid Perfetto timeline.  Off by default (zero per-RPC
+        cost).
+    span_recorder: SpanRecorder | None
+        Share a recorder across components (implies ``trace=True``).
     """
 
     def __init__(
@@ -144,6 +157,8 @@ class EnvPool:
         quarantine=True,
         counters=None,
         pipeline_depth=1,
+        trace=False,
+        span_recorder=None,
     ):
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
@@ -167,6 +182,12 @@ class EnvPool:
         self.quarantine = quarantine
         self.policy = fault_policy if fault_policy is not None else FaultPolicy()
         self.counters = counters if counters is not None else fleet_counters
+        #: cross-process span sink (None = tracing off); producers'
+        #: piggybacked spans land here next to the client-side ones
+        self.spans = (
+            span_recorder if span_recorder is not None
+            else (SpanRecorder() if trace else None)
+        )
         # quarantine state; _lock guards every transition (step runs on the
         # training thread, probes may run from a supervisor thread)
         self._lock = threading.RLock()
@@ -292,7 +313,10 @@ class EnvPool:
         # stepping twice (the id is echoed in the reply and popped on
         # receive, so lock-step results stay bit-identical)
         for req in reqs.values():
-            wire.stamp_message_id(req)
+            mid = wire.stamp_message_id(req)
+            if self.spans is not None:
+                wire.stamp_span_context(req, mid)
+        t0_us = {}  # per-env client-span start (tracing only)
         replies, failed = {}, {}
         awaiting = []
         for i in indices:
@@ -309,6 +333,13 @@ class EnvPool:
                     "failures"
                 )
                 continue
+            if self.spans is not None:
+                # BEFORE the send: the producer stamps its span at
+                # request receipt, which can precede this thread's next
+                # instruction once the zmq enqueue is out — a t0 taken
+                # after the send would let the producer span escape its
+                # enclosing client span
+                t0_us[i] = now_us()
             try:
                 wire.send_message(self.sockets[i], reqs[i])
                 awaiting.append(i)
@@ -349,7 +380,15 @@ class EnvPool:
                             exc_info=True,
                         )
                         continue
+                    piggyback = wire.pop_spans(ddict)
                     ddict.pop(wire.BTMID_KEY, None)
+                    if self.spans is not None:
+                        self.spans.ingest(piggyback)
+                        self.spans.record(make_span(
+                            "env_rpc", t0_us.get(i, now_us()),
+                            trace=reqs[i].get(wire.BTMID_KEY),
+                            cat="envpool", args={"env": i},
+                        ))
                     self.env_times[i] = ddict.get("time")
                     self._states[i].record_success()
                     replies[i] = ddict
@@ -425,6 +464,7 @@ class EnvPool:
                 self._dealer_stale[i] = True
             for _ in range(owed):
                 self._ready.append(self._synthetic_ready_locked(i))
+        flight_recorder.note("quarantine", target=f"env{i}", reason=reason)
         logger.warning("env %d quarantined: %s", i, reason)
 
     def notify_respawn(self, i):
@@ -527,6 +567,9 @@ class EnvPool:
                         self._states[i].record_success()
                         self.counters.incr("readmissions")
                         readmitted.append(i)
+                        flight_recorder.note(
+                            "readmission", target=f"env{i}"
+                        )
                         logger.warning("env %d re-admitted after resync", i)
                     elif malformed or (
                         time.monotonic() - p["started"] >= deadline_s
@@ -647,6 +690,7 @@ class EnvPool:
         self._last_obs[i] = f.pop("obs")
         f.pop("rgb_array", None)
         f.pop(wire.BTMID_KEY, None)
+        f.pop(wire.SPANS_KEY, None)
         f.update(healthy=True, readmitted=True)
         self._needs_reset[i] = False
         return {
@@ -889,6 +933,12 @@ class EnvPool:
                         "time": self.env_times[i],
                     }
                 mid = wire.stamp_message_id(request)
+                if self.spans is not None:
+                    wire.stamp_span_context(request, mid)
+                # span start BEFORE the send: the producer stamps its
+                # span at receipt, which can precede our next
+                # instruction once the zmq enqueue is out
+                t0_us = now_us() if self.spans is not None else 0
                 now = time.monotonic()
                 try:
                     wire.send_message_dealer(
@@ -904,6 +954,7 @@ class EnvPool:
                     "mid": mid, "cmd": request["cmd"], "request": request,
                     "sent_at": now, "expires_at": now + wait_s,
                     "attempt": 0, "discard": False, "reply": None,
+                    "t0_us": t0_us,
                 })
         self._fail_or_quarantine(failed)  # strict mode raises here
         if failed_counts:
@@ -1162,6 +1213,7 @@ class EnvPool:
         as non-echoing AFTER a retry already went out — the FIFO
         fallback can no longer attribute replies safely)."""
         mid = ddict.pop(wire.BTMID_KEY, None)
+        piggyback = wire.pop_spans(ddict)
         with self._lock:
             dq = self._inflight[i]
             self._mid_echo[i] = mid is not None
@@ -1192,6 +1244,12 @@ class EnvPool:
                 self.counters.incr("stale_replies")
                 return None
             rec["reply"] = ddict
+            if self.spans is not None:
+                self.spans.ingest(piggyback)
+                self.spans.record(make_span(
+                    f"env_{rec['cmd']}", rec["t0_us"], trace=rec["mid"],
+                    cat="envpool", args={"env": i},
+                ))
             self._states[i].record_success()
             now = time.monotonic()
             wait_s = self._recv_wait_ms() / 1e3
@@ -1346,6 +1404,8 @@ def launch_env_pool(
     quarantine=True,
     counters=None,
     pipeline_depth=1,
+    trace=False,
+    span_recorder=None,
     **kwargs,
 ):
     """Launch N Blender env instances and yield a connected EnvPool.
@@ -1374,6 +1434,8 @@ def launch_env_pool(
             quarantine=quarantine,
             counters=counters,
             pipeline_depth=pipeline_depth,
+            trace=trace,
+            span_recorder=span_recorder,
         )
         try:
             yield pool

@@ -40,6 +40,7 @@ from __future__ import annotations
 import random
 import time
 
+from blendjax.obs.flight import flight_recorder
 from blendjax.utils.timing import fleet_counters
 
 
@@ -191,16 +192,32 @@ class FaultPolicy:
             try:
                 result = fn(attempt)
             except retryable as exc:
-                state.record_failure(counters)
+                # flight-recorder annotations ride the failure path only
+                # (retries already pay a backoff sleep), so the ring
+                # costs nothing while the fleet is healthy
+                if state.record_failure(counters):
+                    flight_recorder.note(
+                        "circuit_open", target=name,
+                        consecutive_failures=state.consecutive_failures,
+                        cooldown_s=self.circuit_cooldown_s,
+                    )
                 counters.incr("timeouts")
                 out_of_budget = deadline is not None and (
                     self._clock() >= deadline
                 )
                 if attempt >= self.max_retries or out_of_budget:
                     counters.incr("failures")
+                    flight_recorder.note(
+                        "rpc_failure", target=name, attempts=attempt + 1,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
                     raise
                 attempt += 1
                 counters.incr("retries")
+                flight_recorder.note(
+                    "retry", target=name, attempt=attempt,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
                 delay = state.backoff(attempt)
                 if deadline is not None:
                     delay = min(delay, max(0.0, deadline - self._clock()))

@@ -37,6 +37,8 @@ import threading
 import time
 
 from blendjax.btt.watchdog import FleetWatchdog
+from blendjax.obs.flight import default_postmortem_dir, flight_recorder
+from blendjax.obs.histogram import fold_stage_snapshot, stage_records
 from blendjax.utils.timing import FLEET_EVENTS, REPLAY_EVENTS, fleet_counters
 
 logger = logging.getLogger("blendjax")
@@ -86,6 +88,23 @@ class FleetSupervisor:
         counters under.  Give each fleet's supervisor its OWN
         ``EventCounters`` so the per-fleet slices stay disjoint
         (:class:`blendjax.parallel.podracer.FleetSet` does).
+    timer: StageTimer | None
+        Attach the fleet's stage timer (the one its feed/replay path
+        records into) so :meth:`health` reports per-stage latency
+        percentiles (``stages``) next to the counters, and
+        :func:`aggregate_health` can merge the histograms across
+        fleets.
+    hub: blendjax.obs.TelemetryHub | None
+        Register this supervisor's counters/timer/health with a
+        telemetry hub at construction (name ``fleet<id>``), so one
+        ``hub.scrape()`` covers the fleet without extra plumbing.
+    postmortem_dir: str | None
+        Where to dump a flight-recorder postmortem JSON when a producer
+        (or supervised shard process) dies — the crash artifact naming
+        the quarantined target.  Defaults to ``$BJX_POSTMORTEM_DIR``
+        (set by ``make chaos``/``make chaos-replay``); with neither
+        set, deaths are still recorded in the process-wide flight ring
+        but no file is written.
     """
 
     def __init__(
@@ -99,13 +118,27 @@ class FleetSupervisor:
         heal_interval=0.05,
         replay=None,
         fleet_id=None,
+        timer=None,
+        hub=None,
+        postmortem_dir=None,
     ):
         self.launcher = launcher
         self.pool = pool
         self.fleet_id = fleet_id
+        self.timer = timer
+        self.postmortem_dir = (
+            postmortem_dir if postmortem_dir is not None
+            else default_postmortem_dir()
+        )
+        #: path of the most recent postmortem dump (None until a death)
+        self.last_postmortem = None
         if counters is None:
             counters = pool.counters if pool is not None else fleet_counters
         self.counters = counters
+        if hub is not None:
+            hub.register_supervisor(
+                f"fleet{fleet_id if fleet_id is not None else 0}", self
+            )
         self._user_on_death = on_death
         self.watchdog = FleetWatchdog(
             launcher, interval=interval, on_death=self._on_death,
@@ -155,11 +188,20 @@ class FleetSupervisor:
         rec = next(
             (d for d in reversed(self.watchdog.deaths) if d[0] == idx), None
         )
+        target = (
+            f"fleet{self.fleet_id}/instance{idx}"
+            if self.fleet_id is not None else f"instance{idx}"
+        )
         respawned = bool(rec and rec[2])
-        if respawned and idx in self._down:
+        new_death = not (respawned and idx in self._down)
+        if not new_death:
             self._down.discard(idx)  # same death, respawn finally landed
         else:
             self.counters.incr("deaths")
+            flight_recorder.note(
+                "producer_death", target=target,
+                exit_code=code, respawned=respawned,
+            )
         if self.pool is not None and idx < self.pool.num_envs:
             # proactive: stop RPCing a peer known to be dead instead of
             # discovering it one timeout at a time
@@ -189,6 +231,20 @@ class FleetSupervisor:
                 rep.notify_respawn(idx)
         elif self.watchdog.restart:
             self._down.add(idx)  # respawn failed; watchdog retries it
+        if new_death and self.postmortem_dir is not None:
+            # AFTER the quarantines above, so the dump's event ring ends
+            # with what was done about the death, and its health snapshot
+            # reflects the degraded state being entered
+            try:
+                extra = {"target": target, "exit_code": code,
+                         "health": self.health()}
+            except Exception:  # noqa: BLE001 - dump must not cascade
+                extra = {"target": target, "exit_code": code}
+            self.last_postmortem = flight_recorder.dump(
+                directory=self.postmortem_dir,
+                reason=f"death-{target}",
+                extra=extra,
+            )
         self._event.set()
         if self._user_on_death is not None:
             self._user_on_death(idx, code)
@@ -242,6 +298,10 @@ class FleetSupervisor:
         h["alive"] = self.watchdog.alive
         if self.fleet_id is not None:
             h["fleet_id"] = self.fleet_id
+        if self.timer is not None:
+            # per-stage means AND latency percentiles (p50/p90/p99/max)
+            # from the attached StageTimer's histograms
+            h["stages"] = self.timer.summary()
         if self.pool is not None:
             mask = self.pool.healthy
             h["num_envs"] = int(mask.size)
@@ -312,6 +372,7 @@ def aggregate_health(supervisors):
     num_envs = healthy_envs = 0
     alive = True
     dead_fleets = []
+    stage_merge = {}  # the obs.histogram.fold_stage_snapshot accumulator
     for idx, sup in enumerate(supervisors):
         h = sup.health()
         fid = h.get("fleet_id", idx)
@@ -323,6 +384,9 @@ def aggregate_health(supervisors):
         alive = alive and bool(h.get("alive", False))
         if h.get("num_envs", 0) and h.get("healthy_envs", 0) == 0:
             dead_fleets.append(fid)
+        timer = getattr(sup, "timer", None)
+        if timer is not None:
+            fold_stage_snapshot(stage_merge, timer.snapshot())
     agg.update(
         num_fleets=len(fleets),
         num_envs=num_envs,
@@ -331,4 +395,9 @@ def aggregate_health(supervisors):
         dead_fleets=dead_fleets,
         fleets=fleets,
     )
+    if stage_merge:
+        # cross-fleet stage latencies: histograms merged so the
+        # aggregate p99 is a quantile of the UNION of intervals, not a
+        # mean of per-fleet percentiles
+        agg["stages"] = stage_records(stage_merge)
     return agg

@@ -46,6 +46,7 @@ import optax
 
 from blendjax.models import policy
 from blendjax.models.train import TrainState, make_train_step
+from blendjax.obs.flight import flight_recorder
 
 log = logging.getLogger("blendjax")
 
@@ -124,13 +125,18 @@ class ActorLearner:
         Transitions per off-policy update; under ``mesh=`` it must
         divide by the mesh's data-axis size (sampled batches shard the
         same way the rollout batches do).
+    hub: blendjax.obs.TelemetryHub | None
+        Register the training loop's telemetry sources (the replay
+        buffer's counters/timer when one is attached, plus a
+        ``stats``-shaped probe over the fleet/step accounting) so one
+        ``hub.scrape()`` covers acting AND learning.
     """
 
     def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
                  queue_size=4, optimizer=None, gamma=0.99, seed=0,
                  continuous=False, action_map=None, pipeline=False,
                  mesh=None, num_fleets=None,
-                 replay=None, replay_ratio=0, replay_batch=64):
+                 replay=None, replay_ratio=0, replay_batch=64, hub=None):
         self.pools = _as_pools(pool)
         if num_fleets is not None:
             if self.pools and num_fleets != len(self.pools):
@@ -276,6 +282,27 @@ class ActorLearner:
         self._fleet_restarts = [0] * max(1, self.num_fleets)
         self._fleet_restart_allowed = [0.0] * max(1, self.num_fleets)
         self._fleet_restart_steps = [0] * max(1, self.num_fleets)
+        if hub is not None:
+            if replay is not None and hasattr(replay, "register_with_hub"):
+                replay.register_with_hub(hub)
+            elif replay is not None:
+                hub.register(
+                    replay.name, counters=replay.counters,
+                    timer=replay.timer, probe=replay.stats,
+                )
+            hub.register(
+                "actor_learner",
+                probe=lambda: {
+                    "env_steps": self._env_steps,
+                    "unhealthy_env_steps": self._unhealthy_env_steps,
+                    "env_steps_by_fleet": list(self._env_steps_by_fleet),
+                    "fleet_restarts": list(self._fleet_restarts),
+                    "dead_fleets": [
+                        fid for fid, e in enumerate(self._actor_errors)
+                        if e is not None
+                    ],
+                },
+            )
 
     # -- aggregate views -----------------------------------------------------
 
@@ -422,6 +449,10 @@ class ActorLearner:
             else:
                 # multi-fleet: the OTHER fleets keep training — the
                 # fan-in zero-masks this fleet's rows from here on
+                flight_recorder.note(
+                    "fleet_actor_failed", target=f"fleet{fid}",
+                    error=f"{type(exc).__name__}: {exc}",
+                )
                 log.warning(
                     "actor fleet %d failed (%s: %s); remaining fleets "
                     "continue", fid, type(exc).__name__, exc,
@@ -586,6 +617,10 @@ class ActorLearner:
                 name=f"bjx-actor-{fid}.{self._fleet_restarts[fid]}",
             )
             self._threads[fid] = t
+            flight_recorder.note(
+                "fleet_restart", target=f"fleet{fid}",
+                restart=self._fleet_restarts[fid],
+            )
             log.warning(
                 "fleet %d healed: restarting its actor thread "
                 "(restart %d); the fleet rejoins the fan-in", fid,

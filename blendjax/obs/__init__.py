@@ -1,0 +1,59 @@
+"""blendjax.obs — the unified telemetry plane (see docs/observability.md).
+
+Four pieces, all wire-friendly and jax/numpy-free so producer (Blender)
+and shard processes can carry them on their fast paths:
+
+- :class:`~blendjax.obs.histogram.LatencyHistogram` — fixed-memory
+  log-bucketed latency histograms, folded into
+  :class:`blendjax.utils.timing.StageTimer` so every canonical stage
+  reports p50/p90/p99/max, not just means;
+- :mod:`~blendjax.obs.spans` — cross-process trace spans riding the
+  existing ``wire.BTMID_KEY`` correlation ids, piggybacked on replies
+  and merged into one Perfetto/chrome-tracing timeline;
+- :class:`~blendjax.obs.hub.TelemetryHub` — a scrapeable aggregator
+  (JSON + Prometheus text exposition, optional ZMQ REP scrape socket)
+  merging counters and histograms across components and processes;
+- :class:`~blendjax.obs.flight.FlightRecorder` — a bounded ring of
+  recent annotated fault events, dumped as a postmortem JSON on
+  quarantine escalation or process death.
+
+Import-light on purpose (PEP 562, like :mod:`blendjax` itself):
+producers inside Blender's embedded Python import
+``blendjax.obs.spans`` without dragging in the hub's consumer-side
+dependency chain.
+"""
+
+_EXPORTS = {
+    "LatencyHistogram": ("blendjax.obs.histogram", "LatencyHistogram"),
+    "SpanRecorder": ("blendjax.obs.spans", "SpanRecorder"),
+    "export_chrome_trace": ("blendjax.obs.spans", "export_chrome_trace"),
+    "load_chrome_trace": ("blendjax.obs.spans", "load_chrome_trace"),
+    "make_span": ("blendjax.obs.spans", "make_span"),
+    "now_us": ("blendjax.obs.spans", "now_us"),
+    "span_trace": ("blendjax.obs.spans", "span_trace"),
+    "TelemetryHub": ("blendjax.obs.hub", "TelemetryHub"),
+    "scrape_socket": ("blendjax.obs.hub", "scrape_socket"),
+    "FlightRecorder": ("blendjax.obs.flight", "FlightRecorder"),
+    "flight_recorder": ("blendjax.obs.flight", "flight_recorder"),
+    "default_postmortem_dir": (
+        "blendjax.obs.flight", "default_postmortem_dir",
+    ),
+}
+
+
+def __getattr__(name):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'blendjax.obs' has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(module), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_EXPORTS))

@@ -1,0 +1,177 @@
+"""Cross-process trace spans over the existing wire correlation ids.
+
+The feed/RL pipelines already correlate every request/reply pair with a
+``wire.BTMID_KEY`` id; this module turns that id into a **trace id** so
+one env step's producer render, wire transit, arena scatter and learner
+compute appear as one nested timeline across processes:
+
+- a *client* (``EnvPool``, ``ShardClient``) stamps its request with a
+  span context (``wire.SPAN_KEY``) and records a client-side span for
+  the whole RPC, tagged with the correlation id;
+- a *server* (``RemoteControlledAgent``, ``ReplayShard``) that sees the
+  span context records its own recv->work->reply span and ships it back
+  **piggybacked on the reply** (``wire.SPANS_KEY``) — no extra sockets,
+  and jax-free shard/producer processes need no exporter of their own;
+- the client ingests piggybacked spans into its
+  :class:`SpanRecorder`, so ONE :func:`export_chrome_trace` call emits a
+  single Perfetto/chrome-tracing JSON where spans from every pid share
+  a timeline.
+
+Timestamps are **wall-clock epoch microseconds** (``time.time_ns``), not
+process-relative ``perf_counter`` values, so spans recorded in different
+processes on one host align without clock negotiation.  (Cross-HOST
+merging would need NTP-grade clocks; same-host is the deployment today.)
+
+Pure stdlib: producers run inside Blender's embedded Python and shard
+processes are deliberately jax/numpy-free on their fast path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+
+def now_us():
+    """Wall-clock epoch microseconds (the shared span timebase)."""
+    return time.time_ns() // 1000
+
+
+def make_span(name, t0_us, *, dur_us=None, trace=None, cat=None,
+              pid=None, tid=None, args=None):
+    """One chrome-tracing complete event (``ph: "X"``).  ``dur_us=None``
+    closes the span now."""
+    span = {
+        "name": name,
+        "ph": "X",
+        "ts": t0_us,
+        "dur": (now_us() - t0_us) if dur_us is None else dur_us,
+        "pid": os.getpid() if pid is None else pid,
+        "tid": threading.get_ident() if tid is None else tid,
+    }
+    if cat is not None:
+        span["cat"] = cat
+    a = dict(args) if args else {}
+    if trace is not None:
+        a["trace"] = trace
+    if a:
+        span["args"] = a
+    return span
+
+
+def span_trace(span):
+    """The trace (correlation) id a span was tagged with, or None."""
+    return (span.get("args") or {}).get("trace")
+
+
+class SpanRecorder:
+    """Thread-safe bounded ring of completed spans.
+
+    Bounded for the same reason the StageTimer trace ring is: a
+    multi-hour traced run must not exhaust host memory.  Overflow drops
+    the OLDEST spans (the recent window is what a postmortem wants) and
+    counts them in :attr:`dropped`.
+    """
+
+    def __init__(self, capacity=8192):
+        self._lock = threading.Lock()
+        self._spans = deque(maxlen=int(capacity))
+        self._dropped = 0
+
+    @property
+    def capacity(self):
+        return self._spans.maxlen
+
+    @property
+    def dropped(self):
+        with self._lock:
+            return self._dropped
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+    def record(self, span):
+        """Append one span dict (see :func:`make_span`)."""
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._spans.append(span)
+
+    @contextmanager
+    def span(self, name, *, trace=None, cat=None, args=None):
+        """Record the ``with`` block as one span."""
+        t0 = now_us()
+        try:
+            yield
+        finally:
+            self.record(
+                make_span(name, t0, trace=trace, cat=cat, args=args)
+            )
+
+    def ingest(self, spans):
+        """Absorb spans shipped back by a remote peer (a reply's
+        ``wire.SPANS_KEY`` list).  Tolerant of None/[] so reply handling
+        can pop-and-ingest unconditionally."""
+        if not spans:
+            return 0
+        with self._lock:
+            for s in spans:
+                if isinstance(s, dict):
+                    if len(self._spans) == self._spans.maxlen:
+                        self._dropped += 1
+                    self._spans.append(s)
+        return len(spans)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._spans)
+
+    def drain(self):
+        """Pop every recorded span (the PUSH-to-hub consumption mode)."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+            return out
+
+    def export_chrome_trace(self, path, extra=()):
+        """Write this recorder's spans (plus ``extra`` span iterables)
+        as one chrome-tracing JSON; returns the event count."""
+        return export_chrome_trace(path, self.snapshot(), *extra)
+
+
+def export_chrome_trace(path, *span_sources):
+    """Merge span iterables / :class:`SpanRecorder` instances /
+    previously-exported trace file paths into ONE chrome-tracing JSON at
+    ``path`` (loadable in Perfetto / ``chrome://tracing``; each pid gets
+    its own process row).  Events are sorted by timestamp so the
+    timeline reads consistently whatever order sources arrived in.
+    Returns the number of events written."""
+    events = []
+    for src in span_sources:
+        if src is None:
+            continue
+        if isinstance(src, SpanRecorder):
+            events.extend(src.snapshot())
+        elif isinstance(src, (str, os.PathLike)):
+            events.extend(load_chrome_trace(src))
+        else:
+            events.extend(src)
+    events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def load_chrome_trace(path):
+    """Events of a chrome-tracing JSON file (for re-merging exports from
+    several processes into one timeline)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return list(doc.get("traceEvents", []))
+    return list(doc)  # bare event-array form is also valid chrome JSON

@@ -58,8 +58,9 @@ import numpy as np
 
 from blendjax import wire
 from blendjax.btt.file import FileRecorder, scan_messages
+from blendjax.obs.spans import make_span, now_us
 from blendjax.replay.ring import ColumnStore
-from blendjax.utils.timing import fleet_counters
+from blendjax.utils.timing import StageTimer, fleet_counters
 
 logger = logging.getLogger("blendjax")
 
@@ -112,6 +113,11 @@ class ReplayShard:
         self.data_dir = data_dir
         self.checkpoint_every = int(checkpoint_every)
         self.counters = counters if counters is not None else fleet_counters
+        #: server-side stage timer (``shard_srv_<cmd>`` per request, with
+        #: latency histograms) — shipped to clients by the ``telemetry``
+        #: RPC so a consumer-side TelemetryHub can merge this process's
+        #: percentiles without any exporter running here
+        self.timer = StageTimer()
         self.store = ColumnStore(self.capacity)
         #: total rows ever accepted (the durability cursor: checkpoint
         #: meta and spill records carry it, restore resumes from it)
@@ -236,12 +242,19 @@ class ReplayShard:
     def handle(self, msg):
         """Dispatch one decoded request dict -> reply dict (correlation
         id echoed; retried mutating requests served from the reply
-        cache — exactly-once at the storage level)."""
+        cache — exactly-once at the storage level).  A request carrying
+        a span context (``wire.SPAN_KEY``) gets this shard's
+        recv->storage->reply span piggybacked on the reply (a cached
+        reply keeps the ORIGINAL simulation's span — the retry did no
+        storage work)."""
         mid = msg.get(wire.BTMID_KEY)
         cmd = msg.get("cmd")
         if mid is not None and cmd in ("append", "save") \
                 and mid in self._reply_cache:
             return self._reply_cache[mid]
+        span_ctx = msg.get(wire.SPAN_KEY)
+        t0_us = now_us() if isinstance(span_ctx, dict) else 0
+        t0 = time.perf_counter()
         try:
             reply = getattr(self, f"_cmd_{cmd}", self._cmd_unknown)(msg)
         except Exception as exc:  # noqa: BLE001 - surfaced to the client
@@ -249,6 +262,19 @@ class ReplayShard:
                 "replay shard %d: %r failed", self.shard_id, cmd
             )
             reply = {"error": f"{type(exc).__name__}: {exc}"}
+        # stage name clamped to DISPATCHED commands: the cmd string is
+        # client-supplied, and one histogram per distinct garbage value
+        # would grow timer memory (and scrape cardinality) unboundedly
+        stage = (
+            f"shard_srv_{cmd}"
+            if hasattr(self, f"_cmd_{cmd}") else "shard_srv_unknown"
+        )
+        self.timer.add(stage, time.perf_counter() - t0, _t0=t0)
+        if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
+            reply[wire.SPANS_KEY] = [make_span(
+                f"shard{self.shard_id}:{cmd}", t0_us,
+                trace=span_ctx["trace"], cat="replay_shard",
+            )]
         if mid is not None:
             reply[wire.BTMID_KEY] = mid
             if cmd in ("append", "save"):
@@ -322,6 +348,30 @@ class ReplayShard:
     def _cmd_save(self, msg):
         path = self.checkpoint()
         return {"path": path, "seq": self.seq}
+
+    def _cmd_telemetry(self, msg):
+        """This process's telemetry in the TelemetryHub merge shape:
+        counters + per-stage latency histograms (serialized sparse).
+        The PULL half of cross-process scraping — a consumer-side hub
+        registers ``lambda: client.rpc("telemetry")`` as a remote and
+        this shard needs no exporter, no extra socket, no jax."""
+        return {
+            "shard_id": self.shard_id,
+            "pid": os.getpid(),
+            "seq": self.seq,
+            "counters": self.counters.snapshot(),
+            "stages": {
+                name: {
+                    "count": rec["count"],
+                    "total_s": rec["total_s"],
+                    "hist": (
+                        rec["hist"].to_dict()
+                        if rec["hist"] is not None else None
+                    ),
+                }
+                for name, rec in self.timer.snapshot().items()
+            },
+        }
 
     # -- serving -------------------------------------------------------------
 

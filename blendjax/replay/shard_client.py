@@ -46,6 +46,8 @@ import numpy as np
 
 from blendjax import wire
 from blendjax.btt.faults import CircuitOpenError, FaultPolicy
+from blendjax.obs.flight import flight_recorder
+from blendjax.obs.spans import SpanRecorder, make_span, now_us
 from blendjax.replay.buffer import ReplayBuffer, load_client_state
 from blendjax.utils.timing import fleet_counters
 
@@ -88,7 +90,8 @@ class ShardClient:
     """
 
     def __init__(self, address, shard_id=0, *, fault_policy=None,
-                 counters=None, timeoutms=5000, context=None):
+                 counters=None, timeoutms=5000, context=None,
+                 span_recorder=None):
         import zmq
 
         self.address = address
@@ -97,6 +100,9 @@ class ShardClient:
         self.state = self.policy.new_state(key=self.shard_id)
         self.counters = counters if counters is not None else fleet_counters
         self.timeoutms = int(timeoutms)
+        #: cross-process span sink (None = tracing off): client-side RPC
+        #: spans plus the shard's piggybacked server-side spans
+        self.spans = span_recorder
         self._ctx = context or zmq.Context.instance()
         self._sock = None
 
@@ -129,6 +135,9 @@ class ShardClient:
         msg = dict(payload or {})
         msg["cmd"] = cmd
         mid = wire.stamp_message_id(msg)
+        if self.spans is not None:
+            wire.stamp_span_context(msg, mid)
+        t0_us = now_us() if self.spans is not None else 0
         wait_ms = self.timeoutms if timeout_ms is None else int(timeout_ms)
 
         def attempt(n):
@@ -153,6 +162,14 @@ class ShardClient:
                         # owed — keep waiting
                         self.counters.incr("stale_replies")
                         continue
+                    piggyback = wire.pop_spans(reply)
+                    if self.spans is not None:
+                        self.spans.ingest(piggyback)
+                        self.spans.record(make_span(
+                            f"shard{self.shard_id}_rpc:{cmd}", t0_us,
+                            trace=mid, cat="replay_client",
+                            args={"shard": self.shard_id},
+                        ))
                     if "error" in reply:
                         raise RuntimeError(
                             f"replay shard {self.shard_id}: {cmd!r} "
@@ -348,7 +365,8 @@ class ShardedReplay(ReplayBuffer):
     def __init__(self, shards, *, seed=0, prioritized=True, alpha=0.6,
                  beta=0.4, eps=1e-3, counters=None, timer=None,
                  fault_policy=None, timeoutms=5000, name=None,
-                 shard_capacity=None, allow_dead=False, context=None):
+                 shard_capacity=None, allow_dead=False, context=None,
+                 trace=False, span_recorder=None):
         if not shards:
             raise ValueError("ShardedReplay needs at least one shard")
         counters = counters if counters is not None else fleet_counters
@@ -357,14 +375,24 @@ class ShardedReplay(ReplayBuffer):
             circuit_threshold=5, circuit_cooldown_s=2.0, seed=seed,
         )
         self.fault_policy = policy
+        #: cross-process span sink shared by every shard channel (None =
+        #: tracing off); shard-side spans piggybacked on replies land
+        #: here next to the client RPC spans
+        self.spans = (
+            span_recorder if span_recorder is not None
+            else (SpanRecorder() if trace else None)
+        )
         clients = []
         for i, s in enumerate(shards):
             if isinstance(s, ShardClient):
+                if s.spans is None:
+                    s.spans = self.spans
                 clients.append(s)
             else:
                 clients.append(ShardClient(
                     s, i, fault_policy=policy, counters=counters,
                     timeoutms=timeoutms, context=context,
+                    span_recorder=self.spans,
                 ))
         dead_at_init = []
         hellos = []
@@ -471,6 +499,10 @@ class ShardedReplay(ReplayBuffer):
             return
         self._dead[s] = True
         self.counters.incr("replay_shard_quarantined")
+        flight_recorder.note(
+            "replay_shard_quarantined", target=f"shard{s}",
+            reason=reason, buffer=self.name,
+        )
         self.clients[s].reset_channel()
         live = int((~self._dead).sum())
         logger.warning(
@@ -545,6 +577,11 @@ class ShardedReplay(ReplayBuffer):
                 if self.tree is not None:
                     self.tree.set(int(slot), 0.0)
             self.counters.incr("replay_shard_lost", len(lost))
+            flight_recorder.note(
+                "replay_shard_lost", target=f"shard{s}",
+                rows=len(lost), shard_seq=shard_seq, acked=self._acked[s],
+                buffer=self.name,
+            )
             logger.error(
                 "%s: shard %d restored seq %d < acked %d; invalidated "
                 "%d rows in its range", self.name, s, shard_seq,
@@ -580,6 +617,11 @@ class ShardedReplay(ReplayBuffer):
                 self._pending[slot] = False
         self._dead[s] = False
         self.counters.incr("replay_shard_readmissions")
+        flight_recorder.note(
+            "replay_shard_readmission", target=f"shard{s}",
+            seq=self._acked[s], journal_flushed=len(slots),
+            buffer=self.name,
+        )
         logger.warning(
             "%s: shard %d re-admitted at seq %d (%d journaled rows "
             "flushed); full draw domain restored", self.name, s,
@@ -799,6 +841,36 @@ class ShardedReplay(ReplayBuffer):
         return buf
 
     # -- observability -------------------------------------------------------
+
+    def shard_telemetry(self, s, timeout_ms=500):
+        """One shard process's telemetry snapshot (the jax-free shard's
+        ``telemetry`` RPC: counters + per-stage latency histograms in
+        the TelemetryHub merge shape).  Raises :class:`ShardRPCError`
+        for a dead/quarantined shard — the hub reports that as a
+        ``remote_errors`` entry instead of failing the scrape."""
+        with self._cond:
+            if self._dead[s]:
+                raise ShardRPCError(
+                    f"shard {s} is quarantined", int(s)
+                )
+        return self.clients[int(s)].rpc("telemetry", timeout_ms=timeout_ms)
+
+    def register_with_hub(self, hub, name=None):
+        """Wire this buffer into a :class:`~blendjax.obs.TelemetryHub`:
+        the client's counters + stage timer locally, and every shard
+        process as a remote telemetry source (pulled per scrape over
+        the existing RPC channel)."""
+        name = name or self.name
+        hub.register(
+            name, counters=self.counters, timer=self.timer,
+            probe=self.stats,
+        )
+        for s in range(self.num_shards):
+            hub.register_remote(
+                f"{name}/shard{s}",
+                lambda s=s: self.shard_telemetry(s),
+            )
+        return hub
 
     def _diag_locked(self):
         dead = list(np.flatnonzero(self._dead))

@@ -9,25 +9,44 @@ Usage::
     with timer.stage("recv"):
         msg = sock.recv()
     ...
-    timer.summary()   # {'recv': {'count': n, 'total_s': t, 'mean_ms': m}, ...}
+    timer.summary()   # {'recv': {'count': n, 'total_s': t, 'mean_ms': m,
+                      #           'p50_ms': ..., 'p90_ms': ..., 'p99_ms': ...,
+                      #           'max_ms': ...}, ...}
     timer.duty_cycle("step")   # fraction of wall time inside 'step'
+
+Every ``add`` also lands in a fixed-memory log-bucketed latency
+histogram (:class:`blendjax.obs.histogram.LatencyHistogram`), so the
+summary carries per-stage p50/p90/p99/max — the percentile surface the
+telemetry plane (docs/observability.md) scrapes and merges across
+processes.  ``histograms=False`` opts out.
 
 Pass ``trace=True`` to additionally record one event per stage interval
 and ``export_chrome_trace(path)`` them as Chrome trace-event JSON —
 loadable in ``chrome://tracing`` / Perfetto, with loader workers, the
 prefetch thread and the train loop on separate rows so feed stalls are
 visible as gaps.  Tracing is off by default (zero per-stage overhead
-beyond the two timestamps).
+beyond the two timestamps), and the event ring is bounded
+(``trace_cap``; evictions counted in ``trace_dropped``) so multi-hour
+traced runs cannot exhaust host memory.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
+
+from blendjax.obs import histogram as _histogram
+from blendjax.obs.histogram import LatencyHistogram
+
+# hot-path constants for the inlined histogram update in StageTimer.add
+_hist_frexp = math.frexp
+_HIST_TOP = _histogram.NBUCKETS - 1
+_HIST_SUBBITS = _histogram.SUBBITS
 
 #: Canonical feed-pipeline stage names (see docs/feed_pipeline.md).
 #: ``recv``/``collate``/``device_put`` cover the legacy path; the
@@ -153,20 +172,43 @@ class EventCounters:
 fleet_counters = EventCounters()
 
 
+#: Default bound on the ``trace=True`` event ring: ~64k intervals is
+#: hours of feed-stage tracing at typical batch rates while holding a
+#: few MB at most.  Beyond it the OLDEST events are dropped (and counted
+#: in :attr:`StageTimer.trace_dropped`) — the recent window is what a
+#: stall investigation wants, and an unbounded list once exhausted host
+#: memory on multi-hour traced runs.
+DEFAULT_TRACE_CAP = 65536
+
+
 class StageTimer:
     """Accumulates wall-clock time per named stage (thread-safe: stages are
-    recorded from loader workers and the prefetch thread concurrently)."""
+    recorded from loader workers and the prefetch thread concurrently).
 
-    def __init__(self, trace=False):
+    With ``histograms=True`` (the default) every :meth:`add` also lands
+    in a fixed-memory log-bucketed
+    :class:`~blendjax.obs.histogram.LatencyHistogram`, so
+    :meth:`summary` reports p50/p90/p99/max per stage alongside the
+    means — the percentile surface ``health()``, the TelemetryHub and
+    the bench artifacts read.  ``histograms=False`` opts out (the knob
+    the ``telemetry_overhead_x`` bench compares against).
+    """
+
+    def __init__(self, trace=False, histograms=True,
+                 trace_cap=DEFAULT_TRACE_CAP):
         self._lock = threading.Lock()
         self._trace = bool(trace)
+        self._histograms = bool(histograms)
+        self._trace_cap = int(trace_cap)
         self.reset()
 
     def reset(self):
         with self._lock:
             self._total = defaultdict(float)
             self._count = defaultdict(int)
-            self._events = []
+            self._hist = {}
+            self._events = deque(maxlen=self._trace_cap)
+            self._trace_dropped = 0
             self._start = time.perf_counter()
 
     @contextmanager
@@ -177,12 +219,38 @@ class StageTimer:
         finally:
             self.add(name, time.perf_counter() - t0, _t0=t0)
 
-    def add(self, name, seconds, _t0=None):
+    def add(self, name, seconds, _t0=None, _frexp=_hist_frexp,
+            _top=_HIST_TOP, _sub=_HIST_SUBBITS):
         with self._lock:
             self._total[name] += seconds
             self._count[name] += 1
+            if self._histograms:
+                h = self._hist.get(name)
+                if h is None:
+                    h = self._hist[name] = LatencyHistogram()
+                # LatencyHistogram.add inlined AND thinned: this is the
+                # feed/RL hot path, priced by telemetry_overhead_x
+                # (floor 0.95).  The histogram's n/sum_s are NOT
+                # maintained here — inside a StageTimer they duplicate
+                # _count/_total exactly, so _sync_hist_locked derives
+                # them at read time instead of paying two more
+                # attribute RMWs per event
+                us = seconds * 1e6
+                if us < 1.0:
+                    idx = 0
+                else:
+                    m, e = _frexp(us)
+                    idx = ((e - 1) << _sub) + int((m + m - 1.0) *
+                                                  (1 << _sub)) + 1
+                    if idx > _top:
+                        idx = _top
+                h.counts[idx] += 1
+                if seconds > h.max_s:
+                    h.max_s = seconds
             if self._trace:
                 start = _t0 if _t0 is not None else time.perf_counter() - seconds
+                if len(self._events) == self._trace_cap:
+                    self._trace_dropped += 1
                 self._events.append(
                     (name, start, seconds, threading.get_ident())
                 )
@@ -192,10 +260,19 @@ class StageTimer:
         update — for hot loops (e.g. the arena feed path at ~100 us per
         batch) where a per-interval :meth:`add` would itself be a
         measurable stage.  Not recorded as trace events (aggregates have
-        no start times)."""
+        no start times), and histogram entries land at the aggregate's
+        MEAN (per-interval spread is already lost) — percentiles for a
+        stage fed only through here degenerate to that mean."""
+        if count <= 0:
+            return
         with self._lock:
             self._total[name] += total_seconds
             self._count[name] += count
+            if self._histograms:
+                h = self._hist.get(name)
+                if h is None:
+                    h = self._hist[name] = LatencyHistogram()
+                h.add_many(total_seconds / count, count)
 
     @property
     def wall_s(self):
@@ -220,15 +297,63 @@ class StageTimer:
         with self._lock:
             return self._total.get(name, 0.0) / wall if wall > 0 else 0.0
 
+    @property
+    def trace_dropped(self):
+        """Trace events evicted from the bounded ring (oldest first)."""
+        with self._lock:
+            return self._trace_dropped
+
+    def _sync_hist_locked(self, name):
+        """The stage's histogram with ``n``/``sum_s`` derived from
+        ``_count``/``_total`` (the hot-path :meth:`add` skips those two
+        RMWs — inside a StageTimer they are exact duplicates)."""
+        h = self._hist.get(name)
+        if h is not None:
+            h.n = self._count[name]
+            h.sum_s = self._total[name]
+        return h
+
+    def percentiles(self, name):
+        """``{"p50_ms","p90_ms","p99_ms","max_ms"}`` for a stage (zeros
+        when unrecorded or histograms are off)."""
+        with self._lock:
+            h = self._sync_hist_locked(name)
+            if h is None:
+                return {"p50_ms": 0.0, "p90_ms": 0.0, "p99_ms": 0.0,
+                        "max_ms": 0.0}
+            return h.percentiles()
+
     def summary(self):
         with self._lock:
-            return {
-                name: {
+            out = {}
+            for name, total in self._total.items():
+                rec = {
                     "count": self._count[name],
                     "total_s": round(total, 6),
                     "mean_ms": round((total / self._count[name]) * 1e3, 3)
                     if self._count[name]
                     else 0.0,
+                }
+                h = self._sync_hist_locked(name)
+                if h is not None:
+                    rec.update(h.percentiles())
+                out[name] = rec
+            return out
+
+    def snapshot(self):
+        """Mergeable per-stage state for the
+        :class:`~blendjax.obs.hub.TelemetryHub`: ``{stage: {"count",
+        "total_s", "hist"}}`` with the histograms COPIED (the hub merges
+        destructively across components)."""
+        with self._lock:
+            return {
+                name: {
+                    "count": self._count[name],
+                    "total_s": total,
+                    "hist": (
+                        self._sync_hist_locked(name).copy()
+                        if name in self._hist else None
+                    ),
                 }
                 for name, total in self._total.items()
             }

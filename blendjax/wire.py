@@ -50,6 +50,20 @@ BTID_KEY = "btid"
 #: request/response correlation (reference ``duplex.py:60-66``).
 BTMID_KEY = "btmid"
 
+#: Key under which a tracing client stamps its span context into a
+#: request (``{"trace": <correlation id>}``): a server that sees it
+#: records its own recv->work->reply span and ships it back under
+#: :data:`SPANS_KEY`.  Servers that ignore the key keep working
+#: (third-party/legacy producers simply contribute no server-side
+#: spans); see :mod:`blendjax.obs.spans`.
+SPAN_KEY = "btspan"
+
+#: Key under which a server piggybacks its recorded spans (a list of
+#: chrome-tracing event dicts) on a reply.  Clients POP it before the
+#: reply becomes user-visible data (infos, replay rows), whether or not
+#: they are tracing.
+SPANS_KEY = "btspans"
+
 _ARRAY_PLACEHOLDER = "__bjx_nd__"
 
 #: Public alias: key under which a raw-buffer header stores the payload
@@ -182,6 +196,22 @@ def stamp_message_id(data: dict) -> str:
     mid = new_message_id()
     data[BTMID_KEY] = mid
     return mid
+
+
+def stamp_span_context(data: dict, trace: str) -> None:
+    """Stamp a request with the span context that asks the server for a
+    piggybacked span (see :data:`SPAN_KEY`).  ``trace`` is the trace id
+    the server's span will be tagged with — by convention the request's
+    :data:`BTMID_KEY` correlation id, so client and server spans of one
+    RPC share it."""
+    data[SPAN_KEY] = {"trace": trace}
+
+
+def pop_spans(reply: dict):
+    """Remove and return a reply's piggybacked span list (None when the
+    server attached none).  Reply consumers call this unconditionally so
+    span payloads never leak into infos/rows."""
+    return reply.pop(SPANS_KEY, None)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,226 @@
+#!/usr/bin/env python3
+"""Diff two bench headline artifacts with per-metric regression floors.
+
+The repo accumulates a trajectory of bench artifacts (``BENCH_r0x.json``)
+but nothing ever *enforced* it — a PR could halve ``feed_arena_x`` and
+only a human reading JSON would notice.  This tool turns the trajectory
+into a guardrail::
+
+    python scripts/bench_compare.py BENCH_r05.json BENCH_new.json
+    make benchdiff OLD=BENCH_r05.json NEW=BENCH_new.json
+
+Each metric present in BOTH artifacts is compared as ``new / old``
+against its floor (see ``DEFAULT_FLOORS``; override per metric with
+``--floor metric=ratio``).  Any ratio below its floor is a regression:
+the offending rows are printed and the exit code is non-zero, so CI can
+gate on it.  Metrics present in only one artifact are listed as skipped
+— a new metric must not fail the diff retroactively, and a *vanished*
+metric is reported (``--strict`` turns vanished metrics into failures).
+
+Accepted input shapes (auto-detected, so both the raw ``bench.py``
+stdout and the driver's capture wrapper work):
+
+- the compact headline line (``{"headline": true, ...}``),
+- the full artifact line (first line of ``bench.py`` stdout),
+- a ``.jsonl``/multi-line capture of both (later lines win),
+- the driver wrapper (``{"cmd": ..., "tail": "..."}`` — JSON lines are
+  recovered from the tail, e.g. ``BENCH_r05.json``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+#: metric -> minimum acceptable new/old ratio (all metrics here are
+#: higher-is-better).  Floors are loose enough for shared-CI noise on
+#: paired-window medians; tighten per-deployment via --floor.
+DEFAULT_FLOORS = {
+    "value": 0.85,                  # headline images/sec
+    "vs_baseline": 0.85,
+    "feed_arena_x": 0.90,
+    "replay_sample_x": 0.85,
+    "replay_shard_x": 0.80,
+    "replay_degraded_x": 0.85,
+    "rl_steps_per_sec": 0.80,
+    "rl_pipelined_x": 0.85,
+    "rl_sharded_x": 0.80,
+    "telemetry_overhead_x": 0.95,   # itself a ratio; must stay ~free
+}
+
+#: fallback floor for numeric metrics named via --metrics that have no
+#: entry above
+FALLBACK_FLOOR = 0.85
+
+
+def _json_lines(text):
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn/truncated capture line
+        if isinstance(obj, dict):
+            out.append(obj)
+    return out
+
+
+def _flatten(doc, metrics):
+    """Fold one artifact dict's metric values into ``metrics``."""
+    for key in DEFAULT_FLOORS:
+        v = doc.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            metrics[key] = float(v)
+    # full-artifact nesting -> headline names
+    fb = doc.get("feed_bound")
+    if isinstance(fb, dict):
+        if isinstance(fb.get("arena_over_legacy"), (int, float)):
+            metrics["feed_arena_x"] = float(fb["arena_over_legacy"])
+        if isinstance(fb.get("telemetry_overhead_x"), (int, float)):
+            metrics["telemetry_overhead_x"] = float(
+                fb["telemetry_overhead_x"]
+            )
+    rb = doc.get("replay_bench")
+    if isinstance(rb, dict):
+        if isinstance(rb.get("replay_sample_x"), (int, float)):
+            metrics["replay_sample_x"] = float(rb["replay_sample_x"])
+        shard = rb.get("sharded")
+        if isinstance(shard, dict):
+            for k in ("replay_shard_x", "replay_degraded_x"):
+                if isinstance(shard.get(k), (int, float)):
+                    metrics[k] = float(shard[k])
+
+
+def _regex_salvage(text, metrics):
+    """Recover flat metric values from a TRUNCATED capture (pre-r05
+    driver tails cut the single big line mid-JSON — e.g.
+    ``BENCH_r04.json`` — so no line parses whole).  Structured values
+    folded afterwards win over these."""
+    for metric in DEFAULT_FLOORS:
+        hits = re.findall(
+            rf'"{metric}":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)', text
+        )
+        if hits:
+            metrics[metric] = float(hits[-1])
+
+
+def extract_metrics(path):
+    """Metric values from one artifact file (see module docstring for
+    the accepted shapes)."""
+    with open(path) as f:
+        text = f.read()
+    docs = []
+    metrics = {}
+    try:
+        top = json.loads(text)
+    except json.JSONDecodeError:
+        top = None
+    if isinstance(top, dict) and "tail" in top and "metric" not in top:
+        # driver capture wrapper: recover the JSON lines from the tail
+        # (the headline is the LAST line by the bench.py contract);
+        # regex salvage first, so parsed lines override it
+        _regex_salvage(top["tail"], metrics)
+        docs = _json_lines(top["tail"])
+        if isinstance(top.get("parsed"), dict):
+            docs.append(top["parsed"])
+    elif isinstance(top, dict):
+        docs = [top]
+    else:
+        _regex_salvage(text, metrics)
+        docs = _json_lines(text)
+    for doc in docs:  # later lines win (headline overrides full line)
+        _flatten(doc, metrics)
+    if not metrics:
+        raise ValueError(f"{path}: no known bench metrics found")
+    return metrics
+
+
+def compare(old, new, floors, strict=False):
+    """Row-per-metric comparison; returns (rows, regressions)."""
+    rows = []
+    regressions = 0
+    for metric in sorted(set(old) | set(new)):
+        o, n = old.get(metric), new.get(metric)
+        if o is None or n is None:
+            status = "vanished" if n is None else "new"
+            ok = not (strict and n is None)
+            rows.append({
+                "metric": metric, "old": o, "new": n, "ratio": None,
+                "floor": None, "status": status, "ok": ok,
+            })
+            if not ok:
+                regressions += 1
+            continue
+        floor = floors.get(metric, FALLBACK_FLOOR)
+        ratio = (n / o) if o else None
+        ok = ratio is None or ratio >= floor
+        rows.append({
+            "metric": metric, "old": o, "new": n,
+            "ratio": None if ratio is None else round(ratio, 3),
+            "floor": floor,
+            "status": "ok" if ok else "REGRESSION",
+            "ok": ok,
+        })
+        if not ok:
+            regressions += 1
+    return rows, regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("old", help="baseline artifact (e.g. BENCH_r05.json)")
+    ap.add_argument("new", help="candidate artifact")
+    ap.add_argument(
+        "--floor", action="append", default=[], metavar="METRIC=RATIO",
+        help="override a metric's regression floor (repeatable)",
+    )
+    ap.add_argument(
+        "--strict", action="store_true",
+        help="a metric present in OLD but missing from NEW fails the diff",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (one JSON object)")
+    args = ap.parse_args(argv)
+
+    floors = dict(DEFAULT_FLOORS)
+    for spec in args.floor:
+        metric, _, ratio = spec.partition("=")
+        if not ratio:
+            ap.error(f"--floor needs METRIC=RATIO, got {spec!r}")
+        floors[metric] = float(ratio)
+
+    old = extract_metrics(args.old)
+    new = extract_metrics(args.new)
+    rows, regressions = compare(old, new, floors, strict=args.strict)
+
+    if args.as_json:
+        print(json.dumps({
+            "old": args.old, "new": args.new,
+            "regressions": regressions, "rows": rows,
+        }))
+    else:
+        width = max(len(r["metric"]) for r in rows)
+        print(f"bench diff: {args.old} -> {args.new}")
+        for r in rows:
+            o = "-" if r["old"] is None else f"{r['old']:.3f}"
+            n = "-" if r["new"] is None else f"{r['new']:.3f}"
+            ratio = "-" if r["ratio"] is None else f"{r['ratio']:.3f}"
+            floor = "-" if r["floor"] is None else f"{r['floor']:.2f}"
+            print(
+                f"  {r['metric']:<{width}}  {o:>10} -> {n:>10}  "
+                f"x{ratio:>6} (floor {floor})  {r['status']}"
+            )
+        if regressions:
+            print(f"{regressions} regression(s) below floor")
+        else:
+            print("no regressions")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

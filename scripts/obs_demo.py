@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Telemetry-plane demo: one short traced pipeline, three artifacts.
+
+``make obsdemo`` runs a fake-Blender env fleet (the real producer stack
+— ``BaseEnv`` + ``RemoteControlledAgent`` over fake bpy — speaking the
+real wire protocol) under a tracing :class:`~blendjax.btt.envpool.
+EnvPool` with a :class:`~blendjax.btt.supervise.FleetSupervisor` and a
+:class:`~blendjax.obs.TelemetryHub`, then emits into ``--out``:
+
+- ``trace.perfetto.json`` — ONE merged Chrome/Perfetto timeline:
+  consumer-side RPC spans and the producers' piggybacked
+  ``producer_step`` spans share correlation ids across >= 3 pids (this
+  process + each producer process);
+- ``scrape.json`` / ``scrape.prom`` — a hub scrape pulled over the ZMQ
+  REP scrape socket, in JSON and Prometheus text-exposition form
+  (every canonical counter/stage present, latency percentiles filled);
+- ``postmortem-*.json`` — a forced flight-recorder dump: the demo
+  quarantines one env and dumps the ring, naming the target.
+
+Prints one JSON summary line (artifact paths + trace/pid/scrape
+verdicts) so CI can assert on the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "BLENDJAX_BLENDER",
+    os.path.join(_REPO, "tests", "helpers", "fake_blender.py"),
+)
+
+ENV_SCRIPT = os.path.join(_REPO, "tests", "blender", "env.blend.py")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="obs_artifacts",
+                    help="artifact directory (created)")
+    ap.add_argument("--envs", type=int, default=2,
+                    help="producer processes (pids in the trace = envs+1)")
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--physics-us", type=int, default=2000,
+                    help="per-frame producer cost (makes producer spans "
+                         "visibly wide in the timeline)")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+
+    from blendjax.btt.envpool import launch_env_pool
+    from blendjax.obs.flight import flight_recorder
+    from blendjax.obs.hub import TelemetryHub, scrape_socket
+    from blendjax.obs.spans import span_trace
+    from blendjax.utils.timing import EventCounters, StageTimer
+
+    counters = EventCounters()
+    timer = StageTimer()
+    hub = TelemetryHub("obsdemo")
+    summary = {"out": args.out}
+
+    with launch_env_pool(
+        scene="", script=ENV_SCRIPT, num_instances=args.envs,
+        background=True, horizon=1_000_000, timeoutms=30000,
+        start_port=14400, pipeline_depth=2, counters=counters,
+        trace=True, physics_us=args.physics_us,
+    ) as pool:
+        hub.register("fleet0", counters=counters, timer=timer,
+                     probe=lambda: {
+                         "healthy_envs": int(pool.healthy.sum()),
+                         "num_envs": pool.num_envs,
+                     })
+        scrape_addr = hub.serve()
+        pool.reset()
+        # lock-step prefix, then a pipelined stretch — both RPC modes
+        # appear in the trace
+        for step in range(args.steps):
+            actions = [float(step + i) for i in range(args.envs)]
+            with timer.stage("recv"):
+                if step % 2 == 0:
+                    pool.step(actions)
+                else:
+                    pool.step_async(actions)
+                    pool.step_wait_full()
+        # the forced fault: quarantine one env, then dump the ring —
+        # the postmortem workflow without needing a real crash
+        pool.quarantine_env(
+            args.envs - 1, reason="obsdemo forced quarantine"
+        )
+        postmortem = flight_recorder.dump(
+            directory=args.out, reason="obsdemo-forced-quarantine",
+            extra={"target": f"env{args.envs - 1}",
+                   "healthy": pool.healthy.tolist()},
+        )
+        # scrape over the wire (the production path), both formats
+        scrape = scrape_socket(scrape_addr, "json")
+        prom = scrape_socket(scrape_addr, "prometheus")
+        trace_path = os.path.join(args.out, "trace.perfetto.json")
+        n_events = pool.spans.export_chrome_trace(trace_path)
+        spans = pool.spans.snapshot()
+    hub.close()
+
+    with open(os.path.join(args.out, "scrape.json"), "w") as f:
+        json.dump(scrape, f, indent=1)
+    with open(os.path.join(args.out, "scrape.prom"), "w") as f:
+        f.write(prom)
+
+    pids = {s["pid"] for s in spans}
+    # correlation ids present on BOTH a consumer-side and a
+    # producer-side span — the cross-process nesting the trace is for
+    by_trace = {}
+    for s in spans:
+        t = span_trace(s)
+        if t is not None:
+            by_trace.setdefault(t, set()).add(s.get("cat"))
+    cross = sum(
+        1 for cats in by_trace.values()
+        if "envpool" in cats and "producer" in cats
+    )
+    summary.update(
+        trace=trace_path,
+        trace_events=n_events,
+        trace_pids=sorted(pids),
+        cross_process_correlations=cross,
+        scrape_counters_zero_filled=all(
+            k in scrape["counters"]
+            for k in ("quarantines", "replay_shard_quarantined")
+        ),
+        scrape_stages=len(scrape["stages"]),
+        postmortem=postmortem,
+        quarantines=counters.get("quarantines"),
+    )
+    ok = (
+        len(pids) >= args.envs + 1
+        and cross > 0
+        and postmortem is not None
+        and summary["scrape_counters_zero_filled"]
+    )
+    summary["ok"] = ok
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

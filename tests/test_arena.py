@@ -363,11 +363,16 @@ class TestFeedBoundBench:
     def test_measure_reports_both_paths_and_stages(self):
         from benchmarks.feed_bound import measure
 
-        out = measure(width=32, height=24, batch=4, seconds=0.4, nmsgs=8)
+        out = measure(width=32, height=24, batch=4, seconds=0.4, nmsgs=8,
+                      telemetry_seconds=0.8)
         limits = out["feed_limit_batches_per_sec"]
         assert limits["legacy"] > 0 and limits["arena"] > 0
         assert out["arena_over_legacy"] is not None
         assert {"arena_wait", "scatter", "recycle"} <= set(out["stages"])
+        # the telemetry-plane sanity ratio rides along (short budget
+        # here: structure only, the real floor is benched at 3.2 s)
+        assert out["telemetry_overhead_x"] > 0
+        assert out["telemetry"]["enabled_windows"]["n"] >= 4
 
     def test_bench_assemble_carries_feed_bound(self):
         import bench

@@ -91,6 +91,17 @@ def test_proxy_forwards_and_stalls(echo):
         try:
             c.sendall(b"ping")
             assert c.recv(64) == b"ping"
+            # the proxy pumps increment forwarded_bytes AFTER their
+            # sendall, so the client can hold the echoed reply a beat
+            # before EITHER counter lands (on one core the up pump can
+            # be descheduled right after its send while echo + down
+            # pump + client all complete) — bounded wait on both
+            # counters instead of a racy assert
+            deadline = time.monotonic() + 2.0
+            while ((proxy.forwarded_bytes["up"] != 4
+                    or proxy.forwarded_bytes["down"] != 4)
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
             assert proxy.forwarded_bytes["up"] == 4
             assert proxy.forwarded_bytes["down"] == 4
 
@@ -311,6 +322,14 @@ def test_pool_all_quarantined_raises(fake_blender):
                        counters=EventCounters())
         try:
             pool.reset()
+            # kill BEFORE quarantining: against a live producer the
+            # in-step probe's resync handshake can complete within one
+            # probe(block_ms=0) call on a loaded host (the consumer gets
+            # descheduled between the reset send and the POLLIN check),
+            # re-admitting the env and racing away the expected raise —
+            # a dead producer makes the all-quarantined state stable
+            proc = kill_instance(bl, 0)
+            proc.wait(timeout=10)
             pool.quarantine_env(0, reason="test")
             with pytest.raises(TimeoutError, match="all environments"):
                 pool.step([1.0])
