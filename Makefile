@@ -10,8 +10,8 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
-	rlbench rlbench-sharded replaybench multichip dryrun benchdiff \
-	obsdemo
+	rlbench rlbench-sharded replaybench servebench multichip dryrun \
+	benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -134,6 +134,18 @@ multichip:
 replaybench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/replay_benchmark.py \
 		--batch 32 --seconds 6 --sharded
+
+# Policy-serving microbench (docs/serving.md): 8 concurrent episode
+# clients against one continuously-batched seqformer world-model
+# server (KV-cache slot pool, per-row positions) vs the serial
+# one-request-per-REP baseline vs the int8-quantized server, in
+# interleaved order-rotated rounds.  One JSON line with the serving
+# headline: serve_qps, serve_p99_ms (client-observed union p99),
+# serve_batch_x (floor > 1 at 8 clients), serve_int8_x.
+servebench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) benchmarks/serve_benchmark.py \
+		--seconds 18 --clients 8
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

@@ -355,10 +355,31 @@ def main():
             min(120, remaining),
         )
         rl_sharded = rl_lines[-1] if rl_lines else None
+    # fifth configuration: the policy-serving inference tier
+    # (docs/serving.md) — 8 concurrent episode clients against one
+    # continuously-batched seqformer world-model server, interleaved
+    # against the serial one-request-per-REP baseline and the int8
+    # server: serve_qps + serve_p99_ms headline, serve_batch_x /
+    # serve_int8_x ratios.  CPU-pinned child (jax, loopback wire).
+    serve_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 45:
+        serve_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "serve_benchmark.py"),
+                "--seconds", "18",
+                "--clients", "8",
+            ],
+            rl_env,
+            min(90, remaining),
+        )
+        serve_bench = serve_lines[-1] if serve_lines else None
 
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
-                   replay_bench=replay_bench, rl_sharded=rl_sharded)
+                   replay_bench=replay_bench, rl_sharded=rl_sharded,
+                   serve_bench=serve_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -402,9 +423,12 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
+    ("serve_int8_x",),
     ("replay_shard_x", "replay_degraded_x"),
+    ("serve_batch_x",),
     ("rl_sharded_x",),
     ("replay_sample_x",),
+    ("serve_qps", "serve_p99_ms"),
     ("feed_arena_x",),
     ("rl_pipelined_x",),
     ("attn",),
@@ -450,6 +474,18 @@ def headline(out):
         # Sebulba sharded actor-learner speedup over single-device at
         # 4 fleets / 8 fake devices (simulation-bound, physics 8 ms)
         line["rl_sharded_x"] = out["rl_sharded_x"]
+    sb = out.get("serve_bench")
+    if sb and sb.get("serve_qps") is not None:
+        # the policy-serving tier headline: batched QPS + client-
+        # observed p99 at 8 concurrent episodes, with the continuous-
+        # batching-over-serial-REP and int8-over-float ratios
+        line["serve_qps"] = sb["serve_qps"]
+        if sb.get("serve_p99_ms") is not None:
+            line["serve_p99_ms"] = sb["serve_p99_ms"]
+        if sb.get("serve_batch_x") is not None:
+            line["serve_batch_x"] = sb["serve_batch_x"]
+        if sb.get("serve_int8_x") is not None:
+            line["serve_int8_x"] = sb["serve_int8_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -502,12 +538,26 @@ def headline(out):
 
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
-             rl_sharded=None):
+             rl_sharded=None, serve_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
     (tests/test_bench_assembly.py)."""
     extras = {"includes_rendering": False}
+    if serve_bench and serve_bench.get("phase") == "serve_bench":
+        # the inference-tier ceiling: continuous-batched QPS/p99 over
+        # the serial baseline + the int8 ratio, stage percentiles
+        # included — see benchmarks/serve_benchmark.py
+        extras["serve_bench"] = {
+            k: serve_bench[k]
+            for k in (
+                "model", "clients", "slots", "rounds", "window_s",
+                "serve_qps", "serve_p50_ms", "serve_p99_ms",
+                "serve_batch_x", "serve_int8_x", "serve_qps_modes",
+                "pair_ratios", "stages",
+            )
+            if k in serve_bench
+        }
     if feed_bound:
         # the feed ceiling, legacy vs arena assembly (trivial train step,
         # jax-free) — including the arena stage timings (arena_wait /

@@ -48,6 +48,25 @@ REPLAY_SHARD_KEYS = (
 )
 
 
+#: Result-schema keys every ``serve_benchmark.py`` JSON line carries
+#: (phase ``serve_bench``); ``bench.py`` keys off these and
+#: ``tests/test_serve.py`` locks emission against this tuple.
+#: ``serve_qps``/``serve_p99_ms`` are the headline pair (median batched
+#: round; client-observed union p99); ``serve_batch_x`` is continuous
+#: batching over the one-request-per-REP serial baseline at the median
+#: interleaved round; ``serve_int8_x`` is the quantized server's QPS
+#: over the float one (None when ``--no-int8``).
+SERVE_BENCH_KEYS = (
+    "model", "clients", "slots", "obs_dim", "rounds", "window_s",
+    "episode_len",
+    "serve_qps", "serve_p50_ms", "serve_p99_ms",
+    "serve_batch_x", "serve_int8_x",
+    "serve_qps_modes",   # {"batched": .., "serial": .., "int8": ..}
+    "pair_ratios",
+    "stages",
+)
+
+
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)
 

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Policy-serving microbench: QPS + tail latency of the inference tier.
+
+Measures the ``blendjax/serve`` tier end-to-end over loopback TCP — N
+concurrent episode clients (threads) against an in-process
+:class:`~blendjax.serve.server.PolicyServer` — in three modes kept
+alive for the whole run and compared over interleaved, order-rotated
+rounds (the drift-immune house scheme):
+
+- **batched**: continuous batching over the ROUTER socket (admission
+  queue -> pad-to-bucket -> one jitted call per tick);
+- **serial**: the one-request-per-REP baseline (batch size 1) — the
+  ratio ``serve_batch_x = batched/serial`` at the median round is the
+  headline scheduling win (floor: > 1 at >= 8 clients);
+- **int8** (``--int8``, default on): the same batched server on the
+  ``ops/quant``-quantized model — ``serve_int8_x = int8/batched``.
+
+Headline: ``serve_qps`` (median batched round) and ``serve_p99_ms``
+(client-observed per-request latency, merged across every batched
+round's per-client histograms — a real union quantile).  One JSON line;
+keys locked by ``benchmarks/_common.SERVE_BENCH_KEYS``.  See
+docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from blendjax.obs.histogram import LatencyHistogram  # noqa: E402
+
+
+def _build_models(model, *, obs_dim, d_model, n_heads, n_layers, slots,
+                  length, seed, int8):
+    """(float_model, serial_model, int8_model|None) sharing weights."""
+    if model == "linear":
+        from blendjax.serve.server import LinearModel
+
+        mk = lambda: LinearModel(obs_dim=obs_dim, slots=slots, seed=seed)
+        return mk(), mk(), (mk() if int8 else None)
+    if model == "policy":
+        import jax
+
+        from blendjax.models import policy
+        from blendjax.serve.server import PolicyModel
+
+        params = policy.init(jax.random.PRNGKey(seed), obs_dim, 8)
+        return (
+            PolicyModel(params, obs_dim),
+            PolicyModel(params, obs_dim),
+            PolicyModel(params, obs_dim, int8=True) if int8 else None,
+        )
+    if model == "seqformer":
+        import jax
+
+        from blendjax.models import seqformer
+        from blendjax.serve.server import SeqFormerModel
+
+        # rope: no learned-table horizon, so long bench windows ring
+        # through the cache instead of clamping position embeddings
+        params = seqformer.init(
+            jax.random.PRNGKey(seed), obs_dim=obs_dim, d_model=d_model,
+            n_heads=n_heads, n_layers=n_layers, pos_encoding="rope",
+        )
+        mk = lambda **kw: SeqFormerModel(params, slots, length, **kw)
+        return mk(), mk(), (mk(int8=True) if int8 else None)
+    raise ValueError(f"unknown model {model!r}")
+
+
+def _warm_buckets(server, clients):
+    """Pre-compile every bucket a window can hit (one XLA compilation
+    each) so the timed rounds measure serving, not compilation."""
+    model = server.model
+    for b in server.buckets:
+        idx = np.full(b, model.pad_slot, np.int64)
+        model.step_rows(idx, np.zeros((b, model.obs_dim), np.float32))
+        if b >= max(1, clients):
+            break
+
+
+def _run_window(address, obs_dim, seconds, clients, episode_len):
+    """One timed window of ``clients`` concurrent episode loops;
+    returns (qps, merged client-observed latency histogram)."""
+    hists = [LatencyHistogram() for _ in range(clients)]
+    counts = [0] * clients
+    # two barriers so the clock starts only once EVERY client is
+    # connected and reset-ready: ready collects them, the deadline is
+    # stamped between the barriers, go releases — thread spawn and
+    # reset latency never eat the measured window, and every client
+    # stops at the same wall deadline so ``seconds`` is the honest
+    # denominator (teardown close/join excluded)
+    ready = threading.Barrier(clients + 1)
+    go = threading.Barrier(clients + 1)
+    t_deadline = [None]
+    errors = []
+
+    def runner(i):
+        from blendjax.serve.client import ServeClient
+
+        client = ServeClient(address, timeoutms=10000)
+        rng = np.random.default_rng(1000 + i)
+        obs = rng.standard_normal(obs_dim).astype(np.float32)
+        try:
+            client.reset()
+            ready.wait(timeout=30)
+            go.wait(timeout=30)
+            end = t_deadline[0]
+            n = steps = 0
+            while time.perf_counter() < end:
+                t0 = time.perf_counter()
+                client.step(obs)
+                hists[i].add(time.perf_counter() - t0)
+                n += 1
+                steps += 1
+                if steps >= episode_len:
+                    client.close_episode()
+                    client.reset()
+                    steps = 0
+            counts[i] = n
+        except Exception as exc:  # noqa: BLE001 - must not corrupt qps
+            # a dead client thread would silently deflate the window's
+            # counts and histogram — surface it as a failed window (and
+            # break the barriers so a pre-start death fails fast)
+            errors.append(f"client {i}: {type(exc).__name__}: {exc}")
+            ready.abort()
+            go.abort()
+        finally:
+            try:
+                client.close_episode()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            client.close()
+
+    threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    broken = False
+    try:
+        ready.wait(timeout=60)
+        t_deadline[0] = time.perf_counter() + seconds
+        go.wait(timeout=30)
+    except threading.BrokenBarrierError:
+        broken = True  # a client died pre-start; reported below
+    for t in threads:
+        t.join(timeout=seconds + 30)
+    if errors or broken:
+        raise RuntimeError(
+            f"serve bench window lost {len(errors)} client(s): "
+            + ("; ".join(errors) or "barrier broken")
+        )
+    merged = LatencyHistogram()
+    for h in hists:
+        merged.merge(h)
+    return sum(counts) / seconds, merged
+
+
+def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
+            d_model=64, n_heads=4, n_layers=2, slots=None, length=64,
+            episode_len=32, rounds=None, int8=True, seed=0,
+            tick_ms=1.0):
+    """Run the three-mode comparison; returns the serve_bench record."""
+    from blendjax.serve.server import start_server_thread
+    from blendjax.utils.timing import EventCounters, StageTimer
+
+    slots = slots or max(2 * clients, 16)
+    f_model, s_model, q_model = _build_models(
+        model, obs_dim=obs_dim, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, slots=slots, length=length, seed=seed,
+        int8=int8,
+    )
+    rounds = rounds or 3
+    window_s = max(0.5, seconds / (rounds * (3 if int8 else 2)))
+    timer = StageTimer()
+    servers = {
+        "batched": start_server_thread(
+            f_model, counters=EventCounters(), timer=timer,
+            tick_ms=tick_ms,
+        ),
+        "serial": start_server_thread(
+            s_model, serial=True, counters=EventCounters(),
+            timer=StageTimer(),
+        ),
+    }
+    if int8:
+        servers["int8"] = start_server_thread(
+            q_model, counters=EventCounters(), timer=StageTimer(),
+            tick_ms=tick_ms,
+        )
+    qps = {name: [] for name in servers}
+    batched_hist = LatencyHistogram()
+    try:
+        for name, h in servers.items():
+            _warm_buckets(h.server, clients)
+            _run_window(h.address, obs_dim, 0.3, clients, episode_len)
+        order = list(servers)
+        for r in range(rounds):
+            rotated = order[r % len(order):] + order[:r % len(order)]
+            for name in rotated:
+                rate, hist = _run_window(
+                    servers[name].address, obs_dim, window_s, clients,
+                    episode_len,
+                )
+                qps[name].append(rate)
+                if name == "batched":
+                    batched_hist.merge(hist)
+    finally:
+        for h in servers.values():
+            h.close()
+    med = {name: float(np.median(rates)) for name, rates in qps.items()}
+    pair_ratios = [round(b / s, 3)
+                   for b, s in zip(qps["batched"], qps["serial"]) if s]
+    pct = batched_hist.percentiles()
+    out = {
+        "model": model,
+        "clients": clients,
+        "slots": slots,
+        "obs_dim": obs_dim,
+        "rounds": rounds,
+        "window_s": round(window_s, 3),
+        "episode_len": episode_len,
+        "serve_qps": round(med["batched"], 2),
+        "serve_p50_ms": pct["p50_ms"],
+        "serve_p99_ms": pct["p99_ms"],
+        "serve_batch_x": (
+            round(float(np.median(pair_ratios)), 3)
+            if pair_ratios else None
+        ),
+        "serve_int8_x": (
+            round(med["int8"] / med["batched"], 3)
+            if int8 and med.get("batched") else None
+        ),
+        "serve_qps_modes": {k: round(v, 2) for k, v in med.items()},
+        "pair_ratios": pair_ratios,
+        "stages": {
+            k: v for k, v in timer.summary().items()
+            if k in ("queue_wait", "batch_assemble", "compute", "reply")
+        },
+    }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seconds", type=float, default=18.0,
+                    help="total timed budget across all windows")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--model", default="seqformer",
+                    choices=("linear", "policy", "seqformer"))
+    ap.add_argument("--obs-dim", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--length", type=int, default=64)
+    ap.add_argument("--episode-len", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--no-int8", dest="int8", action="store_false")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    rec = measure(
+        seconds=args.seconds, clients=args.clients, model=args.model,
+        obs_dim=args.obs_dim, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, slots=args.slots,
+        length=args.length, episode_len=args.episode_len,
+        rounds=args.rounds, int8=args.int8, seed=args.seed,
+    )
+    line = {
+        "metric": "serve_qps",
+        "value": rec["serve_qps"],
+        "unit": "req/sec",
+        "phase": "serve_bench",
+        **rec,
+    }
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,8 @@ Subpackages
 - ``blendjax.ops``     image ops (sRGB decode, normalize, augment) incl. a
   Pallas TPU kernel for the hot uint8->bf16 path.
 - ``blendjax.parallel`` mesh/sharding helpers and the vectorized env pool.
+- ``blendjax.serve``   policy-serving inference tier: continuous batching
+  of ``step()`` over the DEALER wire, KV-cache slot pools, int8 serving.
 - ``blendjax.obs``     unified telemetry plane: latency histograms,
   cross-process trace spans, TelemetryHub scrapes, flight recorders.
 - ``blendjax.utils``    timing/tracing, logging.

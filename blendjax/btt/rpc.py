@@ -1,0 +1,114 @@
+"""The exactly-once DEALER RPC discipline — one copy, two tiers.
+
+Both wire client tiers — the replay storage client
+(:class:`blendjax.replay.shard_client.ShardClient`) and the serving
+client (:class:`blendjax.serve.client.ServeClient`) — speak the same
+request protocol: stamp a fresh ``wire.BTMID_KEY`` correlation id,
+optionally a span context, send over a DEALER socket, poll for the
+reply whose id matches (dropping mismatches as stale — a previous
+attempt's late reply, or a dead server incarnation's leftovers), raise
+on a remote ``error`` reply, and run the whole attempt under a
+:class:`~blendjax.btt.faults.FaultPolicy` whose retries re-send the
+SAME id so the server's reply cache makes them exactly-once.
+
+That discipline used to live as two ~50-line near-copies that had to
+be bug-fixed in lockstep; :func:`exactly_once_rpc` is the single
+implementation, parameterized by the caller's naming (error text,
+span label/category, policy target name) and error class.
+"""
+
+from __future__ import annotations
+
+import time
+
+from blendjax import wire
+from blendjax.btt.faults import CircuitOpenError
+from blendjax.obs.spans import make_span, now_us
+
+
+def exactly_once_rpc(socket_fn, msg, *, policy, state, counters,
+                     wait_ms, raw_buffers=False, spans=None,
+                     remote_name, span_label, span_cat, span_args=None,
+                     rpc_name, exc_factory, retryable, pop_mid=False):
+    """One exactly-once RPC; returns the decoded reply dict.
+
+    Params
+    ------
+    socket_fn: callable
+        Zero-arg callable returning the (lazily dialed) DEALER socket.
+    msg: dict
+        The request, ``cmd`` included; stamped with a fresh correlation
+        id here (a fault-policy retry re-sends the SAME stamped dict).
+    policy / state / counters:
+        The caller's :class:`FaultPolicy`, its per-target
+        :class:`FaultState`, and the counter sink (``stale_replies``
+        and the policy's retry/timeout counters land there).
+    wait_ms: int
+        Per-attempt reply deadline.
+    spans: SpanRecorder | None
+        When set, the request carries a span context and the reply's
+        piggybacked server spans are ingested alongside a client-side
+        ``{span_label}:{cmd}`` span (category ``span_cat``).
+    remote_name: str
+        Names the remote in remote-failure text.
+    rpc_name: str
+        The fault-policy call name (flight-recorder / counter label).
+    exc_factory: callable
+        ``exc_factory(message) -> Exception`` building the caller's
+        transport error (must be in ``retryable``).
+    retryable: tuple
+        Exception classes the policy retries.
+    pop_mid: bool
+        Strip the echoed correlation id from the returned reply.
+    """
+    import zmq
+
+    cmd = msg.get("cmd")
+    mid = wire.stamp_message_id(msg)
+    if spans is not None:
+        wire.stamp_span_context(msg, mid)
+    t0_us = now_us() if spans is not None else 0
+
+    def attempt(n):
+        sock = socket_fn()
+        wire.send_message_dealer(sock, msg, raw_buffers=raw_buffers)
+        deadline = time.monotonic() + wait_ms / 1000.0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise exc_factory(
+                    f"no reply to {cmd!r} within {wait_ms} ms "
+                    f"(attempt {n + 1})"
+                )
+            if sock.poll(max(1, min(50, int(remaining * 1000))),
+                         zmq.POLLIN):
+                reply = wire.recv_message_dealer(sock)
+                if reply.get(wire.BTMID_KEY) != mid:
+                    # a previous attempt's late reply (or a dead
+                    # incarnation's): this request's reply is still
+                    # owed — keep waiting
+                    counters.incr("stale_replies")
+                    continue
+                piggyback = wire.pop_spans(reply)
+                if spans is not None:
+                    spans.ingest(piggyback)
+                    spans.record(make_span(
+                        f"{span_label}:{cmd}", t0_us, trace=mid,
+                        cat=span_cat, args=span_args,
+                    ))
+                if "error" in reply:
+                    raise RuntimeError(
+                        f"{remote_name}: {cmd!r} failed remotely: "
+                        f"{reply['error']}"
+                    )
+                if pop_mid:
+                    reply.pop(wire.BTMID_KEY, None)
+                return reply
+
+    try:
+        return policy.run(
+            attempt, state=state, counters=counters, name=rpc_name,
+            retryable=retryable,
+        )
+    except CircuitOpenError as exc:
+        raise exc_factory(str(exc)) from exc

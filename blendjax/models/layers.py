@@ -79,3 +79,18 @@ def apply_rope(x, cos, sin):
     out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
     out = out.astype(x.dtype)
     return out[:, 0] if single else out
+
+
+def apply_rope_rows(x, cos, sin):
+    """Rotate a single-position (B, H, Dh) q/k where each batch row sits
+    at its OWN position: ``cos``/``sin`` are (B, Dh/2) tables from
+    :func:`rope_table` over a (B,) position vector.  The per-row decode
+    path of :func:`blendjax.models.seqformer.decode_step` (policy
+    serving: one batched step over episodes at heterogeneous timesteps)
+    uses this; :func:`apply_rope` covers the batch-uniform case."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

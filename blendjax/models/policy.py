@@ -32,11 +32,23 @@ def init(key, obs_dim, num_actions, hidden=(64, 64), continuous=False):
     return params
 
 
+def _dense_mq(p, x):
+    """``dense_apply`` accepting either a float ``{'w', 'b'}`` or an
+    int8 ``{'w_q', 'w_scale', 'b'}`` layer
+    (:func:`blendjax.ops.quant.quantize_policy`) — one ``logits`` body
+    serves both precisions, like the seqformer's dispatch."""
+    if "w_q" in p:
+        from blendjax.ops.quant import dense_apply_int8
+
+        return dense_apply_int8(p, x)
+    return dense_apply(p, x)
+
+
 def logits(params, obs):
     x = jnp.asarray(obs, jnp.float32)
     for layer in params["layers"]:
-        x = jnp.tanh(dense_apply(layer, x))
-    return dense_apply(params["out"], x)
+        x = jnp.tanh(_dense_mq(layer, x))
+    return _dense_mq(params["out"], x)
 
 
 def sample_action(params, key, obs):

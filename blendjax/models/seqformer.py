@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from blendjax.models.layers import (
     apply_rope,
+    apply_rope_rows,
     dense_apply,
     dense_init,
     gelu,
@@ -394,12 +395,23 @@ def train_flops(batch_size, seq_len, obs_dim, d_model, n_heads, n_layers,
 # -- autoregressive rollout (KV cache) --------------------------------------
 
 
-def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
+def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None,
+               per_row=False):
     """Per-layer KV caches: ``{'k': [(B, L, Hkv, Dh)], 'v': [...],
     'pos': 0}``.  ``length`` defaults to the model's ``max_len`` (the
     ``pos`` table); pass the actual decode horizon to size the cache —
     and every step's attention — to the sequence you will run.  Rope
     models have no table and no inherent bound: ``length`` is required.
+
+    ``per_row=True`` makes ``pos`` a ``(B,)`` int32 vector instead of
+    the batch-uniform scalar: every cache row then decodes at its OWN
+    position (:func:`decode_step` dispatches on ``pos``'s rank), which
+    is what a serving tier needs to run ONE batched decode over live
+    episodes at heterogeneous timesteps (``blendjax/serve``).  Resetting
+    a single episode is ``cache['pos'].at[i].set(0)`` — stale k/v rows
+    need no zeroing because :func:`_attn_one` masks by each slot's
+    absolute position, which turns negative the moment the row's
+    position rewinds.
     """
     if length is None:
         if "pos" not in params:
@@ -418,7 +430,11 @@ def init_cache(params, batch_size, dtype=jnp.bfloat16, length=None):
             f"table ({params['pos'].shape[0]}); use pos_encoding='rope' "
             "for longer horizons"
         )
-    caches = {"k": [], "v": [], "pos": jnp.asarray(0, jnp.int32)}
+    pos0 = (
+        jnp.zeros((batch_size,), jnp.int32)
+        if per_row else jnp.asarray(0, jnp.int32)
+    )
+    caches = {"k": [], "v": [], "pos": pos0}
     for blk in params["blocks"]:
         wk = blk["wk"]
         _, h_kv, dh = (wk["w"] if "w" in wk else wk["w_q"]).shape
@@ -437,13 +453,31 @@ def _attn_one(q, kc, vc, pos, scale, window=None):
     unifies the no-wrap case (C >= sequence: it reduces to ``s <= pos``)
     with the O(window)-memory ring (C >= window: overwritten slots fall
     outside the window by construction).  GQA broadcasts the cached
-    heads."""
+    heads.
+
+    ``pos`` is either the batch-uniform scalar (training rollouts) or a
+    ``(B,)`` vector — one position per row, giving a (B, C) mask so one
+    batched decode serves episodes at heterogeneous timesteps (the
+    serving tier's path).  The scalar branch is the exact pre-serving
+    code: rollout numerics are untouched."""
     b, c, h_kv, dh = kc.shape
     h = q.shape[1]
-    slot_pos = pos - ((pos - jnp.arange(c)) % c)
-    keep = slot_pos >= 0  # never-written slots sit at negative positions
-    if window is not None:
-        keep = jnp.logical_and(keep, slot_pos > pos - window)
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        slot_pos = pos - ((pos - jnp.arange(c)) % c)
+        keep = slot_pos >= 0  # never-written slots: negative positions
+        if window is not None:
+            keep = jnp.logical_and(keep, slot_pos > pos - window)
+        keep_g = keep[None, None, None]   # over (B, Hkv, G, C)
+        keep_h = keep[None, None]         # over (B, H, C)
+    else:
+        p_col = pos[:, None]              # (B, 1)
+        slot_pos = p_col - ((p_col - jnp.arange(c)[None]) % c)
+        keep = slot_pos >= 0              # (B, C)
+        if window is not None:
+            keep = jnp.logical_and(keep, slot_pos > p_col - window)
+        keep_g = keep[:, None, None, :]
+        keep_h = keep[:, None, :]
     if h_kv != h:
         # grouped einsum straight against the un-repeated cache —
         # materializing a repeated copy per decode step would pay
@@ -452,13 +486,13 @@ def _attn_one(q, kc, vc, pos, scale, window=None):
         qg = q.reshape(b, h_kv, g, dh).astype(jnp.float32)
         s = jnp.einsum("bkgd,blkd->bkgl", qg,
                        kc.astype(jnp.float32)) * scale
-        s = jnp.where(keep[None, None, None], s, -1e30)
+        s = jnp.where(keep_g, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bkgl,blkd->bkgd", p, vc.astype(jnp.float32))
         return out.reshape(b, h, dh)
     s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
                    kc.astype(jnp.float32)) * scale
-    s = jnp.where(keep[None, None], s, -1e30)
+    s = jnp.where(keep_h, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhl,blhd->bhd", p, vc.astype(jnp.float32))
 
@@ -478,37 +512,67 @@ def decode_step(params, cache, obs_t, compute_dtype=jnp.bfloat16,
     window effectively attends to the last ``C`` positions only —
     size the cache to the horizon (what :func:`rollout` does) unless
     you want exactly that.
+
+    ``cache['pos']`` may be a ``(B,)`` vector (``init_cache(...,
+    per_row=True)``): each row then embeds, rotates, writes its ring
+    slot and masks at its OWN position, so one batched call decodes
+    episodes at heterogeneous timesteps — the policy-serving tier's
+    continuous-batching kernel (parity with per-episode scalar decode
+    is locked by ``tests/test_serve.py``).  The scalar path is
+    byte-for-byte the pre-serving code.
     """
     from jax import lax
 
     pos = cache["pos"]
+    per_row = jnp.ndim(pos) == 1
     use_rope = "pos" not in params
     x = _dense_mq(params["embed"], obs_t.astype(compute_dtype),
                   compute_dtype)
     if use_rope:
-        cos, sin = rope_table(pos[None], _wq_head_dim(params))
+        cos, sin = rope_table(pos if per_row else pos[None],
+                              _wq_head_dim(params))
+    elif per_row:
+        # per-row table lookup; clip mirrors dynamic_index_in_dim's
+        # out-of-bounds clamp on the scalar path (init_cache rejects
+        # horizons past the table statically)
+        x = x + jnp.take(params["pos"], pos, axis=0,
+                         mode="clip").astype(compute_dtype)
     else:
         x = x + lax.dynamic_index_in_dim(
             params["pos"], pos, keepdims=False
         ).astype(compute_dtype)[None]
     new_cache = {"k": [], "v": [], "pos": pos + 1}
+    rows = jnp.arange(obs_t.shape[0]) if per_row else None
     for i, blk in enumerate(params["blocks"]):
         h = _ln_apply(blk["ln1"], x)
         q = _proj_mq(blk["wq"], h, "bd,dhk->bhk", compute_dtype)
         k_new = _proj_mq(blk["wk"], h, "bd,dhk->bhk", compute_dtype)
         v_new = _proj_mq(blk["wv"], h, "bd,dhk->bhk", compute_dtype)
         if use_rope:
-            q = apply_rope(q, cos, sin)
-            k_new = apply_rope(k_new, cos, sin)
+            if per_row:
+                q = apply_rope_rows(q, cos, sin)
+                k_new = apply_rope_rows(k_new, cos, sin)
+            else:
+                q = apply_rope(q, cos, sin)
+                k_new = apply_rope(k_new, cos, sin)
         slot = pos % cache["k"][i].shape[1]  # ring buffer (see _attn_one)
-        kc = lax.dynamic_update_slice_in_dim(
-            cache["k"][i], k_new[:, None].astype(cache["k"][i].dtype),
-            slot, axis=1,
-        )
-        vc = lax.dynamic_update_slice_in_dim(
-            cache["v"][i], v_new[:, None].astype(cache["v"][i].dtype),
-            slot, axis=1,
-        )
+        if per_row:
+            # scatter each row's k/v at ITS ring slot
+            kc = cache["k"][i].at[rows, slot].set(
+                k_new.astype(cache["k"][i].dtype)
+            )
+            vc = cache["v"][i].at[rows, slot].set(
+                v_new.astype(cache["v"][i].dtype)
+            )
+        else:
+            kc = lax.dynamic_update_slice_in_dim(
+                cache["k"][i], k_new[:, None].astype(cache["k"][i].dtype),
+                slot, axis=1,
+            )
+            vc = lax.dynamic_update_slice_in_dim(
+                cache["v"][i], v_new[:, None].astype(cache["v"][i].dtype),
+                slot, axis=1,
+            )
         new_cache["k"].append(kc)
         new_cache["v"].append(vc)
         dh = q.shape[-1]

@@ -193,6 +193,22 @@ def quantize_seqformer(params):
     return out
 
 
+def quantize_policy(params):
+    """Offline PTQ of a :mod:`blendjax.models.policy` MLP pytree for
+    INFERENCE serving: every dense layer goes w8 (per-output-column
+    scales, the :func:`quantize_dense`/:func:`dense_apply_int8` pair);
+    the Gaussian head's ``log_std`` stays f32.  ``policy.logits``
+    dispatches per weight dict, so the same policy code serves both
+    precisions (the ``blendjax/serve`` ``--int8`` path)."""
+    out = {
+        "layers": [quantize_dense(p) for p in params["layers"]],
+        "out": quantize_dense(params["out"]),
+    }
+    if "log_std" in params:
+        out["log_std"] = params["log_std"]
+    return out
+
+
 def quantize_detector(params):
     """Offline PTQ of a trained :mod:`blendjax.models.detector` pytree:
     every conv and dense layer goes w8; biases stay f32."""

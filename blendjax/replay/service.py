@@ -360,17 +360,7 @@ class ReplayShard:
             "pid": os.getpid(),
             "seq": self.seq,
             "counters": self.counters.snapshot(),
-            "stages": {
-                name: {
-                    "count": rec["count"],
-                    "total_s": rec["total_s"],
-                    "hist": (
-                        rec["hist"].to_dict()
-                        if rec["hist"] is not None else None
-                    ),
-                }
-                for name, rec in self.timer.snapshot().items()
-            },
+            "stages": self.timer.snapshot_serialized(),
         }
 
     # -- serving -------------------------------------------------------------
@@ -494,12 +484,13 @@ class ShardFleet:
         self._cmds = []
 
     def _spawn(self, cmd):
-        env = dict(os.environ)
-        repo = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))
-        ))
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-        return subprocess.Popen(cmd, env=env, start_new_session=True)
+        # shared child-environment policy (see launcher.child_env:
+        # repo root prepended to PYTHONPATH); function-level import so
+        # the shard child's own fast-start surface stays lean
+        from blendjax.btt.launcher import child_env
+
+        return subprocess.Popen(cmd, env=child_env(),
+                                start_new_session=True)
 
     def __enter__(self):
         from blendjax.replay.shard_client import free_port

@@ -45,9 +45,9 @@ import time
 import numpy as np
 
 from blendjax import wire
-from blendjax.btt.faults import CircuitOpenError, FaultPolicy
+from blendjax.btt.faults import FaultPolicy
 from blendjax.obs.flight import flight_recorder
-from blendjax.obs.spans import SpanRecorder, make_span, now_us
+from blendjax.obs.spans import SpanRecorder
 from blendjax.replay.buffer import ReplayBuffer, load_client_state
 from blendjax.utils.timing import fleet_counters
 
@@ -129,65 +129,31 @@ class ShardClient:
     def rpc(self, cmd, payload=None, *, timeout_ms=None, raw_buffers=False):
         """One exactly-once RPC under the fault policy; returns the
         decoded reply dict, raises :class:`ShardRPCError` (transport)
-        or ``RuntimeError`` (the shard executed and reported failure)."""
-        import zmq
+        or ``RuntimeError`` (the shard executed and reported failure).
+        The retry/stale-reply discipline itself is the shared
+        :func:`blendjax.btt.rpc.exactly_once_rpc`."""
+        from blendjax.btt.rpc import exactly_once_rpc
 
         msg = dict(payload or {})
         msg["cmd"] = cmd
-        mid = wire.stamp_message_id(msg)
-        if self.spans is not None:
-            wire.stamp_span_context(msg, mid)
-        t0_us = now_us() if self.spans is not None else 0
-        wait_ms = self.timeoutms if timeout_ms is None else int(timeout_ms)
-
-        def attempt(n):
-            sock = self._socket()
-            wire.send_message_dealer(sock, msg, raw_buffers=raw_buffers)
-            deadline = time.monotonic() + wait_ms / 1000.0
-            while True:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise ShardRPCError(
-                        f"replay shard {self.shard_id} "
-                        f"({self.address}): no reply to {cmd!r} within "
-                        f"{wait_ms} ms (attempt {n + 1})",
-                        self.shard_id,
-                    )
-                if sock.poll(max(1, min(50, int(remaining * 1000))),
-                             zmq.POLLIN):
-                    reply = wire.recv_message_dealer(sock)
-                    if reply.get(wire.BTMID_KEY) != mid:
-                        # a previous attempt's late reply (or a dead
-                        # incarnation's): this request's reply is still
-                        # owed — keep waiting
-                        self.counters.incr("stale_replies")
-                        continue
-                    piggyback = wire.pop_spans(reply)
-                    if self.spans is not None:
-                        self.spans.ingest(piggyback)
-                        self.spans.record(make_span(
-                            f"shard{self.shard_id}_rpc:{cmd}", t0_us,
-                            trace=mid, cat="replay_client",
-                            args={"shard": self.shard_id},
-                        ))
-                    if "error" in reply:
-                        raise RuntimeError(
-                            f"replay shard {self.shard_id}: {cmd!r} "
-                            f"failed remotely: {reply['error']}"
-                        )
-                    return reply
-
-        try:
-            return self.policy.run(
-                attempt, state=self.state, counters=self.counters,
-                name=f"replay-shard-{self.shard_id}:{cmd}",
-                retryable=(ShardRPCError,),
-            )
-        except CircuitOpenError as exc:
-            raise ShardRPCError(
-                f"replay shard {self.shard_id} ({self.address}): {exc}",
-                self.shard_id,
-            ) from exc
+        return exactly_once_rpc(
+            self._socket, msg,
+            policy=self.policy, state=self.state,
+            counters=self.counters,
+            wait_ms=(self.timeoutms if timeout_ms is None
+                     else int(timeout_ms)),
+            raw_buffers=raw_buffers, spans=self.spans,
+            remote_name=f"replay shard {self.shard_id}",
+            span_label=f"shard{self.shard_id}_rpc",
+            span_cat="replay_client",
+            span_args={"shard": self.shard_id},
+            rpc_name=f"replay-shard-{self.shard_id}:{cmd}",
+            exc_factory=lambda text: ShardRPCError(
+                f"replay shard {self.shard_id} ({self.address}): "
+                f"{text}", self.shard_id,
+            ),
+            retryable=(ShardRPCError,),
+        )
 
 
 class _ShardedStore:

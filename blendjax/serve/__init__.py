@@ -1,0 +1,52 @@
+"""Policy-serving inference tier (docs/serving.md) — the system's third
+workload family: train -> replay -> **serve**.
+
+One :class:`~blendjax.serve.server.PolicyServer` process owns a model
+(MLP policy, seqformer world model, or the jax-free linear stand-in)
+and serves ``step()``/``reset()``/``close()`` to many concurrent
+episode clients over the DEALER wire with **continuous batching**: the
+admission queue drains every tick into bucketed batch sizes, one jitted
+call serves the tick, and for stateful world models every live episode
+holds a row in a **KV-cache slot pool** decoded at per-row positions
+(``seqformer.init_cache(per_row=True)``).  Retries are exactly-once via
+the ``wire.BTMID_KEY`` reply cache; ``--int8`` serves the
+``ops/quant``-quantized model through the same code.
+
+Public surface::
+
+    from blendjax.serve import (
+        PolicyServer, ServeClient, ServeRPCError, ServerProcess,
+        LinearModel, PolicyModel, SeqFormerModel, start_server_thread,
+    )
+
+Imports stay lazy (PEP 562) so ``ServeClient``-only consumers and the
+jax-free ``LinearModel`` server process never pay the model stack.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "PolicyServer": "blendjax.serve.server",
+    "LinearModel": "blendjax.serve.server",
+    "PolicyModel": "blendjax.serve.server",
+    "SeqFormerModel": "blendjax.serve.server",
+    "ServerProcess": "blendjax.serve.server",
+    "start_server_thread": "blendjax.serve.server",
+    "default_buckets": "blendjax.serve.server",
+    "ServeClient": "blendjax.serve.client",
+    "ServeRPCError": "blendjax.serve.client",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

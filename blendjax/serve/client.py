@@ -1,0 +1,171 @@
+"""ServeClient: one episode's blocking RPC channel to a PolicyServer.
+
+The consumer half of the serving tier (docs/serving.md): a DEALER
+socket speaking the empty-delimiter framing from :mod:`blendjax.wire`,
+every RPC stamped with a ``wire.BTMID_KEY`` correlation id and run
+under a :class:`~blendjax.btt.faults.FaultPolicy` — a retry re-sends
+the SAME id, the server's reply cache answers it without a second
+decode, and replies whose id does not match the outstanding request are
+dropped as stale (the ``ShardClient`` discipline pointed at inference).
+
+Episode protocol::
+
+    client = ServeClient("tcp://host:24000")
+    slot = client.reset()            # admit an episode (KV-cache slot)
+    for obs in episode:
+        pred = client.step(obs)      # one batched-on-the-server decode
+    client.close_episode()           # release the slot
+
+A step against a restarted server (fresh slot pool) raises
+``RuntimeError`` naming the unknown slot; call :meth:`reset` and
+resume — the recovery path the chaos tests exercise under
+``FleetWatchdog`` respawns.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from blendjax.btt.faults import FaultPolicy
+from blendjax.utils.timing import fleet_counters
+
+logger = logging.getLogger("blendjax")
+
+
+class ServeRPCError(TimeoutError):
+    """A serve RPC failed at the transport level (no reply within the
+    policy, circuit open).  Subclasses :class:`TimeoutError` so callers
+    that treat outages as retriable-later (reset-and-resume loops)
+    handle them uniformly."""
+
+
+class ServeClient:
+    """Blocking exactly-once RPCs to one :class:`~blendjax.serve.server.
+    PolicyServer` (ROUTER/batched or REP/serial — the DEALER framing
+    serves both unmodified)."""
+
+    def __init__(self, address, *, fault_policy=None, counters=None,
+                 timeoutms=5000, context=None, span_recorder=None,
+                 name="serve"):
+        import zmq
+
+        self.address = address
+        self.name = name
+        self.policy = fault_policy or FaultPolicy()
+        self.state = self.policy.new_state()
+        self.counters = counters if counters is not None else fleet_counters
+        self.timeoutms = int(timeoutms)
+        self.slot = None  # the live episode's slot after reset()
+        self.episode = None  # ... and its lease id (see reset())
+        #: cross-process span sink (None = tracing off): client RPC
+        #: spans plus the server's piggybacked serve-side spans
+        self.spans = span_recorder
+        self._ctx = context or zmq.Context.instance()
+        self._sock = None
+
+    def _socket(self):
+        import zmq
+
+        if self._sock is None:
+            s = self._ctx.socket(zmq.DEALER)
+            s.setsockopt(zmq.LINGER, 0)
+            s.connect(self.address)
+            self._sock = s
+        return self._sock
+
+    def reset_channel(self):
+        """Drop the DEALER socket so the next RPC dials fresh (stale
+        replies of a dead server incarnation die with the old one)."""
+        if self._sock is not None:
+            self._sock.close(0)
+            self._sock = None
+
+    close = reset_channel
+
+    def rpc(self, cmd, payload=None, *, timeout_ms=None,
+            raw_buffers=False):
+        """One exactly-once RPC under the fault policy; returns the
+        decoded reply dict.  Raises :class:`ServeRPCError` (transport)
+        or ``RuntimeError`` (the server executed and reported failure).
+        The retry/stale-reply discipline is the shared
+        :func:`blendjax.btt.rpc.exactly_once_rpc`."""
+        from blendjax.btt.rpc import exactly_once_rpc
+
+        msg = dict(payload or {})
+        msg["cmd"] = cmd
+        return exactly_once_rpc(
+            self._socket, msg,
+            policy=self.policy, state=self.state,
+            counters=self.counters,
+            wait_ms=(self.timeoutms if timeout_ms is None
+                     else int(timeout_ms)),
+            raw_buffers=raw_buffers, spans=self.spans,
+            remote_name="policy server",
+            span_label="serve_rpc", span_cat="serve_client",
+            rpc_name=f"{self.name}:{cmd}",
+            exc_factory=lambda text: ServeRPCError(
+                f"policy server ({self.address}): {text}"
+            ),
+            retryable=(ServeRPCError,),
+            pop_mid=True,
+        )
+
+    # -- episode protocol ----------------------------------------------------
+
+    def hello(self, timeout_ms=None):
+        return self.rpc("hello", timeout_ms=timeout_ms)
+
+    def reset(self, timeout_ms=None):
+        """Admit an episode: returns (and remembers) its slot id.  The
+        reply's episode *lease* id rides every later step/close, so a
+        slot the server evicted and reassigned refuses this client's
+        stale steps instead of advancing the new tenant's cache."""
+        reply = self.rpc("reset", timeout_ms=timeout_ms)
+        self.slot = int(reply["slot"])
+        self.episode = reply.get("episode")
+        return self.slot
+
+    def step(self, obs, slot=None, timeout_ms=None):
+        """One served ``step``: returns the reply dict (``pred`` is the
+        model output row; stateful servers may add ``pos``, the
+        position this observation consumed)."""
+        use = self.slot if slot is None else slot
+        if use is None:
+            raise RuntimeError("step() before reset(): no episode slot")
+        reply = self.rpc(
+            "step",
+            {"slot": int(use), "episode": self.episode,
+             "obs": np.asarray(obs, np.float32)},
+            timeout_ms=timeout_ms, raw_buffers=True,
+        )
+        reply["pred"] = np.asarray(reply["pred"])
+        return reply
+
+    def close_episode(self, timeout_ms=None):
+        if self.slot is None:
+            return False
+        reply = self.rpc(
+            "close", {"slot": self.slot, "episode": self.episode},
+            timeout_ms=timeout_ms,
+        )
+        self.slot = None
+        self.episode = None
+        return bool(reply.get("closed"))
+
+    def stats(self, timeout_ms=None):
+        return self.rpc("stats", timeout_ms=timeout_ms)
+
+    def telemetry(self, timeout_ms=None):
+        """The server process's telemetry snapshot (TelemetryHub merge
+        shape: counters + serialized per-stage histograms)."""
+        return self.rpc("telemetry", timeout_ms=timeout_ms)
+
+    def register_with_hub(self, hub, name="serve"):
+        """Wire the served process into a :class:`~blendjax.obs.hub.
+        TelemetryHub` as a remote source (pulled per scrape over this
+        RPC channel; a dead server surfaces as ``remote_errors``, never
+        a failed scrape)."""
+        hub.register_remote(name, lambda: self.telemetry(timeout_ms=500))
+        return hub

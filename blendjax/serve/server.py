@@ -1,0 +1,1006 @@
+"""PolicyServer: continuous batching of ``step()`` over the DEALER wire.
+
+ROADMAP #3 opens the system's third workload family (train -> replay ->
+**serve**): production traffic means *inference*, and until now every
+consumer owned its own model replica and stepped alone.  This module
+puts ONE model behind the existing wire protocol and serves thousands
+of concurrent episodes from it:
+
+- **continuous batching** (the TPU-serving scheduling result,
+  arXiv:2605.25645): an admission queue is drained every tick, pending
+  ``step`` requests are padded to a **bucketed** batch size (XLA
+  compiles once per bucket, not once per occupancy), ONE jitted model
+  call serves the tick, and replies scatter back per client over the
+  ROUTER socket;
+- **KV-cache slot pool** for stateful world-model serving: every live
+  episode holds a row in batched ``(S, ...)`` cache arrays, a slot
+  allocator handles admission/eviction on episode end, and
+  :func:`blendjax.models.seqformer.decode_step` runs with **per-row
+  positions** (``init_cache(per_row=True)``) so one batched decode
+  serves episodes at heterogeneous timesteps — parity with per-episode
+  serial decode is the correctness bar (tests/test_serve.py);
+- **exactly-once RPCs**: every request carries a ``wire.BTMID_KEY``
+  correlation id and a fault-policy retry re-sends the SAME id; the
+  server answers a retried mutating request (``step``/``reset``/
+  ``close``) from a bounded reply cache instead of decoding twice —
+  the ``RemoteControlledAgent`` reply-cache pattern pointed at
+  inference.  A duplicate of a request still *queued* is dropped at
+  admission (the original's reply answers both);
+- an ``--int8`` path serves the model through
+  :func:`blendjax.ops.quant.quantize_seqformer` /
+  :func:`~blendjax.ops.quant.quantize_policy` — the same model code,
+  int8 weights;
+- the house telemetry vocabulary end-to-end: ``SERVE_EVENTS`` counters,
+  ``SERVE_STAGES`` (queue_wait / batch_assemble / compute / reply)
+  with latency histograms via :class:`~blendjax.utils.timing.StageTimer`,
+  a ``telemetry`` RPC in the TelemetryHub merge shape (remote scrape
+  like ``ReplayShard``), and trace spans riding ``BTMID_KEY``.
+
+Run a server as a process (the ``--model linear`` stand-in is jax-free
+and fast-starting, so chaos tests SIGKILL/respawn it cheaply)::
+
+    python -m blendjax.serve.server --address tcp://127.0.0.1:24000 \
+        --model seqformer --seed 0 --obs-dim 8 --slots 64 --length 128
+
+or in-process via :func:`start_server_thread`, or supervised via
+:class:`ServerProcess` (a launcher-compatible surface, so
+:class:`~blendjax.btt.watchdog.FleetWatchdog` respawns a dead server
+and clients resume after ``reset()``).  The **serial** mode
+(``serial=True``: a REP socket answering one request per exchange,
+batch size 1) is the baseline the benchmark's ``serve_batch_x``
+compares continuous batching against.
+
+See docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from blendjax import wire
+from blendjax.obs.spans import make_span, now_us
+from blendjax.utils.timing import StageTimer, fleet_counters
+
+logger = logging.getLogger("blendjax")
+
+#: Commands whose replies enter the exactly-once reply cache (they
+#: mutate episode state — a retry must NOT re-execute them).
+MUTATING_CMDS = ("step", "reset", "close")
+
+#: Idle horizon after which a STATELESS episode leaves the admission
+#: window's live-count (window *targeting* only — stateless steps are
+#: never refused).  A client idle this long is not co-arriving within a
+#: millisecond tick window, and without decay every crashed consumer
+#: would inflate the target until every batch waits out its full
+#: ``tick_ms``.  Stateful servers use ``slot_ttl_s`` eviction instead.
+STATELESS_TTL_S = 30.0
+
+#: Default bound on the reply cache.  Each client keeps at most one RPC
+#: outstanding (ServeClient is blocking), so the cache must cover the
+#: retry window of roughly the live client count — 1024 replies of a
+#: few hundred bytes is comfortably larger than any sane fleet while
+#: bounding server memory.
+REPLY_CACHE_DEPTH = 1024
+
+
+def default_buckets(max_batch):
+    """Powers of two up to ``max_batch`` (inclusive as the cap): each
+    bucket is one XLA compilation, so requests pad to the next bucket
+    instead of compiling per occupancy."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# served models
+# ---------------------------------------------------------------------------
+
+
+class LinearModel:
+    """Jax-free stateful stand-in: ``pred = obs @ W + pos`` with a
+    per-slot position counter.  Deterministic from ``seed`` (a
+    respawned process rebuilds the same weights), position-sensitive
+    (a double-applied step shifts every later prediction, so
+    exactly-once violations are *visible*), and import-cheap — the
+    chaos tests SIGKILL/respawn servers of this model in well under a
+    second."""
+
+    kind = "linear"
+
+    def __init__(self, obs_dim=8, out_dim=None, slots=16, seed=0):
+        self.obs_dim = int(obs_dim)
+        self.out_dim = int(out_dim or obs_dim)
+        self.slots = int(slots)
+        rng = np.random.default_rng(seed)
+        self.w = rng.standard_normal(
+            (self.obs_dim, self.out_dim)
+        ).astype(np.float32)
+        # +1: the pad row batched ticks scatter their padding into
+        self.pos = np.zeros(self.slots + 1, np.int64)
+        self.pad_slot = self.slots
+
+    def reset_rows(self, idx):
+        self.pos[idx] = 0
+
+    def step_rows(self, idx, obs):
+        pred = obs.astype(np.float32) @ self.w \
+            + self.pos[idx, None].astype(np.float32)
+        self.pos[idx] += 1
+        return pred
+
+
+class PolicyModel:
+    """Stateless MLP policy serving (:mod:`blendjax.models.policy`):
+    one jitted ``logits`` per bucket, greedy (argmax) actions — the
+    deterministic serving convention.  ``int8=True`` serves
+    :func:`~blendjax.ops.quant.quantize_policy` output through the same
+    ``logits`` body (per-weight-dict dispatch)."""
+
+    kind = "policy"
+    slots = 0  # stateless: no cache rows, reset is an accounting no-op
+    pad_slot = 0
+
+    def __init__(self, params, obs_dim, int8=False):
+        import jax
+
+        from blendjax.models import policy
+
+        if int8:
+            from blendjax.ops.quant import quantize_policy
+
+            params = quantize_policy(params)
+        self.params = params
+        self.obs_dim = int(obs_dim)
+        self.int8 = bool(int8)
+        self._logits = jax.jit(policy.logits)
+
+    def reset_rows(self, idx):
+        pass
+
+    def step_rows(self, idx, obs):
+        return np.asarray(self._logits(self.params, obs))
+
+
+class SeqFormerModel:
+    """Stateful world-model serving: a slot pool of batched KV caches
+    (``init_cache(per_row=True)``) over ``slots + 1`` rows — the extra
+    row absorbs batch padding writes — stepped by ONE jitted gather ->
+    ``decode_step`` (per-row positions) -> scatter per bucket size.
+
+    ``int8=True`` serves :func:`~blendjax.ops.quant.quantize_seqformer`
+    output — ``decode_step`` already dispatches per weight dict, so the
+    same serving code runs both precisions."""
+
+    kind = "seqformer"
+
+    def __init__(self, params, slots, length, *, window=None,
+                 compute_dtype=None, cache_dtype=None, int8=False):
+        import jax
+        import jax.numpy as jnp
+
+        from blendjax.models import seqformer
+
+        if int8:
+            from blendjax.ops.quant import quantize_seqformer
+
+            params = quantize_seqformer(params)
+        self.params = params
+        self.slots = int(slots)
+        self.length = int(length)
+        self.window = window
+        self.int8 = bool(int8)
+        self.pad_slot = self.slots
+        emb = params["embed"]
+        self.obs_dim = (
+            emb["w"] if "w" in emb else emb["w_q"]
+        ).shape[0]
+        cdt = compute_dtype or jnp.float32
+        self._cache = seqformer.init_cache(
+            params, self.slots + 1, dtype=cache_dtype or cdt,
+            length=self.length, per_row=True,
+        )
+        self._jnp = jnp
+
+        def _step(params, cache, idx, obs):
+            rows = {
+                "pos": cache["pos"][idx],
+                "k": [k[idx] for k in cache["k"]],
+                "v": [v[idx] for v in cache["v"]],
+            }
+            pred, new = seqformer.decode_step(
+                params, rows, obs, compute_dtype=cdt, window=window,
+            )
+            # scatter the stepped rows back; padding duplicates all
+            # land on the pad row, whose contents are never read
+            cache = {
+                "pos": cache["pos"].at[idx].set(new["pos"]),
+                "k": [c.at[idx].set(nk)
+                      for c, nk in zip(cache["k"], new["k"])],
+                "v": [c.at[idx].set(nv)
+                      for c, nv in zip(cache["v"], new["v"])],
+            }
+            return pred, cache
+
+        # one compilation per (bucket,) shape — the bucket/recompile
+        # tradeoff the admission queue pads for
+        self._step = jax.jit(_step)
+
+    def reset_rows(self, idx):
+        # rewinding pos to 0 is sufficient: _attn_one masks by each
+        # slot's absolute position, so the stale k/v rows of the slot's
+        # previous tenant sit at negative positions and never attend
+        self._cache["pos"] = self._cache["pos"].at[
+            self._jnp.asarray(idx)
+        ].set(0)
+
+    def step_rows(self, idx, obs):
+        pred, self._cache = self._step(
+            self.params, self._cache, self._jnp.asarray(idx),
+            self._jnp.asarray(obs),
+        )
+        return np.asarray(pred)  # fence: compute timing stays honest
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+
+class _Pending:
+    __slots__ = ("ident", "mid", "msg", "t_enq", "span_trace", "t0_us")
+
+    def __init__(self, ident, mid, msg, span_trace, t0_us):
+        self.ident = ident
+        self.mid = mid
+        self.msg = msg
+        self.t_enq = time.perf_counter()
+        self.span_trace = span_trace
+        self.t0_us = t0_us
+
+
+class PolicyServer:
+    """One served model behind a ROUTER socket (continuous batching) or
+    a REP socket (``serial=True`` — the one-request-per-exchange
+    baseline ``serve_batch_x`` is measured against).
+
+    Params
+    ------
+    address: str
+        Endpoint to bind (``tcp://host:*`` binds an ephemeral port;
+        resolved endpoint on :attr:`address`).
+    model:
+        A served-model adapter (:class:`LinearModel`,
+        :class:`PolicyModel`, :class:`SeqFormerModel`): ``kind``,
+        ``obs_dim``, ``slots`` (0 = stateless), ``pad_slot``,
+        ``reset_rows(idx)``, ``step_rows(idx, obs)``.
+    serial: bool
+        REP socket, batch size 1, no queue — the serial baseline.
+    tick_ms: float
+        Admission window once the queue is non-empty: how long one tick
+        waits for more arrivals before computing (latency it trades for
+        batch occupancy).
+    max_batch: int
+        Largest bucket (and the most requests one tick serves).
+    buckets: tuple | None
+        Pad-to sizes (one XLA compilation each); default powers of two
+        up to ``max_batch``.
+    slot_ttl_s: float | None
+        Idle-slot eviction horizon: a ``reset`` finding no free slot
+        reclaims slots idle longer than this (None = never evict, the
+        reset is denied instead).
+    """
+
+    def __init__(self, address, model, *, serial=False, tick_ms=2.0,
+                 max_batch=64, buckets=None, slot_ttl_s=None,
+                 reply_cache_depth=REPLY_CACHE_DEPTH, counters=None,
+                 timer=None, context=None):
+        import zmq
+
+        self.model = model
+        self.serial = bool(serial)
+        self.tick_ms = float(tick_ms)
+        self.buckets = tuple(sorted(
+            int(b) for b in (buckets or default_buckets(int(max_batch)))
+        ))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive: {self.buckets}")
+        # the largest bucket IS the most requests one tick can pad to —
+        # a max_batch beyond it would index past the padded arrays
+        self.max_batch = min(int(max_batch), self.buckets[-1])
+        self.slot_ttl_s = slot_ttl_s
+        self.counters = counters if counters is not None else fleet_counters
+        self.timer = timer if timer is not None else StageTimer()
+        self._reply_cache = OrderedDict()
+        self._reply_cache_depth = int(reply_cache_depth)
+        self._queue = deque()
+        self._pending = {}  # mid -> _Pending still queued (dedupe)
+        self._free = list(range(model.slots))
+        # slot -> [episode lease id, monotonic last-use].  The lease id
+        # disambiguates slot REUSE: an evicted episode's client still
+        # holds the slot number, and without the lease its next step
+        # would silently advance the new tenant's cache row
+        self._live = {}
+        self._episode_seq = 0
+        # stateless models have no slot pool, but the admission window
+        # still needs a live-episode count for its early exit (a
+        # blocking client keeps one step in flight, so waiting past
+        # that count is pure latency): episode id -> last monotonic
+        # use, touched by reset AND step (so a client that resumed
+        # past a server restart re-registers), pruned after
+        # STATELESS_TTL_S idle (a crashed client must not inflate the
+        # window target forever — state*ful* slots decay via
+        # slot_ttl_s eviction, this is the stateless analogue)
+        self._stateless_eps = {}
+        self._ctx = context or zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP if self.serial
+                                      else zmq.ROUTER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if address.endswith(":*") or address.endswith(":0"):
+            base = address.rsplit(":", 1)[0]
+            port = self._sock.bind_to_random_port(base)
+            self.address = f"{base}:{port}"
+        else:
+            self._sock.bind(address)
+            self.address = address
+
+    # -- slot pool -----------------------------------------------------------
+
+    def _alloc_slot(self):
+        """Returns (slot, episode lease id) or (None, None) when full."""
+        if self.model.slots == 0:
+            self._episode_seq += 1
+            self._stateless_eps[self._episode_seq] = time.monotonic()
+            return -1, self._episode_seq
+        if not self._free and self.slot_ttl_s is not None:
+            now = time.monotonic()
+            stale = [s for s, (_, ts) in self._live.items()
+                     if now - ts > self.slot_ttl_s]
+            for s in stale:
+                del self._live[s]
+                self._free.append(s)
+            if stale:
+                self.counters.incr("serve_evictions", len(stale))
+        if not self._free:
+            return None, None
+        slot = self._free.pop()
+        self._episode_seq += 1
+        self._live[slot] = [self._episode_seq, time.monotonic()]
+        self.model.reset_rows(np.asarray([slot]))
+        return slot, self._episode_seq
+
+    def _free_slot(self, slot, episode=None):
+        lease = self._live.get(slot)
+        if lease is None:
+            return False
+        if episode is not None and lease[0] != episode:
+            return False  # a stale close must not kill the new tenant
+        del self._live[slot]
+        self._free.append(slot)
+        return True
+
+    # -- request handling ----------------------------------------------------
+
+    def _cmd_hello(self, msg):
+        return {
+            "model": self.model.kind,
+            "obs_dim": self.model.obs_dim,
+            "slots": self.model.slots,
+            "free_slots": len(self._free),
+            "serial": self.serial,
+            "int8": bool(getattr(self.model, "int8", False)),
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "pid": os.getpid(),
+        }
+
+    def _cmd_reset(self, msg):
+        slot, episode = self._alloc_slot()
+        if slot is None:
+            self.counters.incr("serve_slot_denied")
+            return {"error": (
+                f"no free episode slot ({self.model.slots} live); close "
+                "an episode or raise slots="
+            )}
+        self.counters.incr("serve_resets")
+        return {"slot": slot, "episode": episode}
+
+    def _cmd_close(self, msg):
+        if self.model.slots == 0:
+            closed = self._stateless_eps.pop(
+                msg.get("episode"), None
+            ) is not None
+        else:
+            closed = self._free_slot(int(msg.get("slot", -1)),
+                                     msg.get("episode"))
+        if closed:
+            # a no-op close (unknown slot, stale/pruned lease, a
+            # restarted server) is answered but not counted:
+            # serve_resets vs serve_closes must reconcile
+            self.counters.incr("serve_closes")
+        return {"closed": closed}
+
+    def _cmd_stats(self, msg):
+        return {
+            "model": self.model.kind,
+            "slots": self.model.slots,
+            "live_slots": len(self._live),
+            "live_episodes": (
+                len(self._live) if self.model.slots > 0
+                else len(self._stateless_eps)
+            ),
+            "free_slots": len(self._free),
+            "queued": len(self._queue),
+            "serial": self.serial,
+            "counters": self.counters.snapshot(),
+            "pid": os.getpid(),
+        }
+
+    def _cmd_telemetry(self, msg):
+        """This process's telemetry in the TelemetryHub merge shape —
+        the PULL half of remote scraping (a consumer-side hub registers
+        ``lambda: client.telemetry()`` and this server needs no
+        exporter, no extra socket)."""
+        return {
+            "model": self.model.kind,
+            "pid": os.getpid(),
+            "counters": self.counters.snapshot(),
+            "stages": self.timer.snapshot_serialized(),
+        }
+
+    def _control_reply(self, msg):
+        cmd = msg.get("cmd")
+        handler = getattr(self, f"_cmd_{cmd}", None)
+        if handler is None:
+            reply = {"error": f"unknown serve command {cmd!r}"}
+        else:
+            try:
+                reply = handler(msg)
+            except Exception as exc:  # noqa: BLE001 - surfaced to client
+                logger.exception("policy server: %r failed", cmd)
+                reply = {"error": f"{type(exc).__name__}: {exc}"}
+        if "error" in reply:
+            self.counters.incr("serve_errors")
+        return reply
+
+    def _finish(self, ident, msg, reply, *, span_name, t0_us):
+        """Stamp correlation id + span, cache mutating replies, send."""
+        mid = msg.get(wire.BTMID_KEY)
+        span_ctx = msg.get(wire.SPAN_KEY)
+        if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
+            reply = dict(reply)
+            reply[wire.SPANS_KEY] = [make_span(
+                span_name, t0_us, trace=span_ctx["trace"], cat="serve",
+            )]
+        if mid is not None:
+            reply[wire.BTMID_KEY] = mid
+            if msg.get("cmd") in MUTATING_CMDS:
+                self._reply_cache[mid] = reply
+                while len(self._reply_cache) > self._reply_cache_depth:
+                    self._reply_cache.popitem(last=False)
+        self._send(ident, reply)
+
+    def _send(self, ident, reply):
+        import zmq
+
+        try:
+            if self.serial:
+                wire.send_message(self._sock, reply, raw_buffers=True)
+            else:
+                wire.send_message_router(self._sock, ident, reply,
+                                         raw_buffers=True)
+            self.counters.incr("serve_replies")
+        except zmq.ZMQError:
+            pass  # client gone; its retry will re-dial
+
+    def _admit(self, ident, msg):
+        """One decoded request: answer control commands immediately,
+        queue ``step``s for the next tick, dedupe retries."""
+        self.counters.incr("serve_requests")
+        mid = msg.get(wire.BTMID_KEY)
+        cmd = msg.get("cmd")
+        t0_us = now_us()
+        if mid is not None and cmd in MUTATING_CMDS \
+                and mid in self._reply_cache:
+            # retry of a request already executed: exactly-once — the
+            # cached reply answers it, nothing re-runs
+            self.counters.incr("serve_cache_hits")
+            self._send(ident, self._reply_cache[mid])
+            return
+        if cmd != "step":
+            reply = self._control_reply(msg)
+            self._finish(ident, msg, reply, span_name=f"serve:{cmd}",
+                         t0_us=t0_us)
+            return
+        if mid is not None and mid in self._pending:
+            # retry of a request still QUEUED: the original's reply
+            # will answer it — re-point the route and drop the dup
+            self.counters.incr("serve_dup_inflight")
+            self._pending[mid].ident = ident
+            return
+        span_ctx = msg.get(wire.SPAN_KEY)
+        trace = (span_ctx or {}).get("trace") \
+            if isinstance(span_ctx, dict) else None
+        ent = _Pending(ident, mid, msg, trace, t0_us)
+        self._queue.append(ent)
+        if mid is not None:
+            self._pending[mid] = ent
+
+    def _step_entry_error(self, ent, text):
+        self.counters.incr("serve_errors")
+        self._finish(ent.ident, ent.msg, {"error": text},
+                     span_name="serve:step", t0_us=ent.t0_us)
+
+    def _tick(self):
+        """Drain up to ``max_batch`` queued steps into one padded,
+        bucketed model call and scatter the replies."""
+        t_assemble = time.perf_counter()
+        stateful = self.model.slots > 0
+        batch = []
+        while self._queue and len(batch) < self.max_batch:
+            ent = self._queue.popleft()
+            if ent.mid is not None:
+                self._pending.pop(ent.mid, None)
+            slot = int(ent.msg.get("slot", -1)) if stateful else -1
+            if not stateful:
+                ep = ent.msg.get("episode")
+                if ep is not None:
+                    # touch (or re-register, after a server restart)
+                    # the episode's liveness for window targeting —
+                    # stateless steps are never refused
+                    self._stateless_eps[ep] = time.monotonic()
+            if stateful:
+                lease = self._live.get(slot)
+                if lease is None:
+                    self._step_entry_error(ent, (
+                        f"unknown episode slot {slot} (closed, evicted, "
+                        "or a restarted server): reset() and resume"
+                    ))
+                    continue
+                if ent.msg.get("episode") not in (None, lease[0]):
+                    # slot number reused by a NEW episode: the stale
+                    # client must not advance the new tenant's cache
+                    self._step_entry_error(ent, (
+                        f"stale episode lease for slot {slot} (evicted "
+                        "and reassigned): reset() and resume"
+                    ))
+                    continue
+            try:
+                obs = np.asarray(ent.msg.get("obs"), np.float32)
+            except (TypeError, ValueError) as exc:
+                self._step_entry_error(
+                    ent, f"step obs not coercible to float32: {exc}"
+                )
+                continue
+            if obs.shape != (self.model.obs_dim,):
+                self._step_entry_error(ent, (
+                    f"step obs shape {obs.shape} != "
+                    f"({self.model.obs_dim},)"
+                ))
+                continue
+            batch.append((ent, slot, obs))
+        if not batch:
+            return
+        n = len(batch)
+        bucket = next((b for b in self.buckets if b >= n),
+                      self.buckets[-1])
+        for ent, _, _ in batch:
+            self.timer.add("queue_wait", t_assemble - ent.t_enq)
+        idx = np.full(bucket, self.model.pad_slot, np.int64)
+        obs_arr = np.zeros((bucket, self.model.obs_dim), np.float32)
+        pos_before = []
+        now = time.monotonic()
+        for j, (ent, slot, obs) in enumerate(batch):
+            idx[j] = slot if stateful else j
+            obs_arr[j] = obs
+            if stateful:
+                self._live[slot][1] = now
+            pos_before.append(
+                int(self.model.pos[slot])
+                if hasattr(self.model, "pos") and stateful else None
+            )
+        t_compute = time.perf_counter()
+        self.timer.add("batch_assemble", t_compute - t_assemble)
+        try:
+            preds = self.model.step_rows(idx, obs_arr)
+        except Exception as exc:  # noqa: BLE001 - server must survive
+            logger.exception("policy server: batched step failed")
+            for ent, _, _ in batch:
+                self._step_entry_error(
+                    ent, f"batched step failed: {type(exc).__name__}: "
+                         f"{exc}"
+                )
+            return
+        t_reply = time.perf_counter()
+        self.timer.add("compute", t_reply - t_compute)
+        self.counters.incr("serve_batches")
+        if bucket > n:
+            self.counters.incr("serve_batch_pad", bucket - n)
+        for j, (ent, slot, _) in enumerate(batch):
+            reply = {"pred": np.ascontiguousarray(preds[j])}
+            if pos_before[j] is not None:
+                reply["pos"] = pos_before[j]
+            self._finish(ent.ident, ent.msg, reply,
+                         span_name="serve:step", t0_us=ent.t0_us)
+        self.timer.add("reply", time.perf_counter() - t_reply)
+
+    # -- serving -------------------------------------------------------------
+
+    def _window_target(self):
+        """Queue occupancy at which an admission window stops waiting:
+        every live episode (a blocking client keeps at most one step in
+        flight, so a fuller window cannot form), capped at the largest
+        bucket.  Stateless episodes are tracked by last use and pruned
+        after :data:`STATELESS_TTL_S` idle; the ``max(1, ...)`` keeps a
+        client that never reset servable instead of deadlocking the
+        window."""
+        if self.model.slots > 0:
+            live = len(self._live)
+        else:
+            if self._stateless_eps:
+                cutoff = time.monotonic() - STATELESS_TTL_S
+                for ep, ts in list(self._stateless_eps.items()):
+                    if ts < cutoff:
+                        del self._stateless_eps[ep]
+            live = len(self._stateless_eps)
+        return min(self.max_batch, max(1, live))
+
+    def _drain(self):
+        """Admit every request currently sitting on the socket."""
+        import zmq
+
+        while True:
+            try:
+                ident, msg = wire.recv_message_router(
+                    self._sock, flags=zmq.NOBLOCK
+                )
+            except zmq.Again:
+                return
+            except zmq.ZMQError:
+                raise  # socket closed: the outer loop shuts down
+            except Exception as exc:  # noqa: BLE001 - server survives
+                # an undecodable frame (garbling proxy, misbehaving
+                # client) is dropped, never fatal: the frames are
+                # consumed, the sender's retry re-sends intact bytes
+                self.counters.incr("serve_errors")
+                logger.warning(
+                    "policy server: undecodable request dropped "
+                    "(%s: %s)", type(exc).__name__, exc,
+                )
+                continue
+            self._admit(ident, msg)
+
+    def serve_forever(self, stop_event=None, poll_ms=50):
+        import zmq
+
+        if self.serial:
+            self._serve_serial(stop_event, poll_ms)
+            return
+        while stop_event is None or not stop_event.is_set():
+            try:
+                if not self._queue:
+                    self._sock.poll(poll_ms, zmq.POLLIN)
+                    self._drain()
+                    if not self._queue:
+                        continue
+                # admission window: work is queued — wait up to tick_ms
+                # for co-arriving requests (the latency the scheduler
+                # trades for occupancy).  Leave early on the first
+                # empty poll slice, a full bucket, or once every LIVE
+                # episode has a step queued (episodes step one request
+                # at a time, so nobody else can arrive — waiting out
+                # the window would be pure latency)
+                t_end = time.perf_counter() + self.tick_ms / 1000.0
+                while len(self._queue) < self._window_target():
+                    rem_ms = (t_end - time.perf_counter()) * 1e3
+                    if rem_ms <= 0:
+                        break
+                    if not self._sock.poll(max(1, int(rem_ms)),
+                                           zmq.POLLIN):
+                        break  # window elapsed with nothing new
+                    self._drain()
+            except zmq.ZMQError:
+                return  # socket closed under us: clean shutdown
+            if self._queue:
+                self._tick()
+
+    def _serve_serial(self, stop_event, poll_ms):
+        """The REP baseline: one request, one (batch-1) reply."""
+        import zmq
+
+        while stop_event is None or not stop_event.is_set():
+            try:
+                if not self._sock.poll(poll_ms, zmq.POLLIN):
+                    continue
+                try:
+                    msg = wire.recv_message(self._sock)
+                except zmq.ZMQError:
+                    return
+                except Exception as exc:  # noqa: BLE001 - see _drain
+                    # REP alternation: the garbled request was consumed,
+                    # so a reply is owed before the next recv (_send
+                    # keeps the serve_replies count honest)
+                    self.counters.incr("serve_errors")
+                    logger.warning(
+                        "policy server: undecodable request (%s: %s)",
+                        type(exc).__name__, exc,
+                    )
+                    self._send(None, {
+                        "error": "undecodable request (corrupt frames)"
+                    })
+                    continue
+            except zmq.ZMQError:
+                return
+            self._admit(None, msg)
+            if self._queue:
+                self._tick()
+
+    def close(self):
+        try:
+            self._sock.close(0)
+        except Exception:  # noqa: BLE001 - shutdown best-effort
+            pass
+
+
+# ---------------------------------------------------------------------------
+# in-process and supervised-process surfaces
+# ---------------------------------------------------------------------------
+
+
+class _LocalServerHandle:
+    """An in-process server (thread) for tests and benchmarks."""
+
+    def __init__(self, server, thread, stop):
+        self.server = server
+        self.address = server.address
+        self._thread = thread
+        self._stop = stop
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_server_thread(model, *, address="tcp://127.0.0.1:*",
+                        serial=False, counters=None, timer=None,
+                        **kwargs):
+    """Serve a :class:`PolicyServer` from a daemon thread; returns a
+    handle with ``.address``, ``.server`` and ``.close()``."""
+    server = PolicyServer(
+        address, model, serial=serial, counters=counters, timer=timer,
+        **kwargs,
+    )
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=server.serve_forever, kwargs={"stop_event": stop},
+        daemon=True, name="bjx-policy-server",
+    )
+    thread.start()
+    return _LocalServerHandle(server, thread, stop)
+
+
+class _ServeLaunchInfo:
+    """Duck-typed ``launch_info`` so :class:`~blendjax.btt.watchdog.
+    FleetWatchdog` supervises the server process exactly like Blender
+    producers or replay shards."""
+
+    def __init__(self, processes, addresses):
+        self.processes = processes
+        self.addresses = {"SERVE": addresses}
+
+
+class ServerProcess:
+    """One policy-server *process* with a launcher-compatible surface
+    (``launch_info`` + ``respawn(idx)``) so ``FleetWatchdog(restart=
+    True)`` respawns it after a SIGKILL with its original command line.
+    Model state is rebuilt deterministically from ``--seed`` — episode
+    slots are fresh, which is exactly the contract clients see: a step
+    against a restarted server errors (unknown slot) and the client
+    resumes with ``reset()``."""
+
+    def __init__(self, *, model="linear", address=None, seed=0,
+                 obs_dim=8, slots=16, length=64, window=None,
+                 num_actions=4, int8=False, serial=False, tick_ms=2.0,
+                 max_batch=64, python=None, ready_timeout=60.0,
+                 extra_args=()):
+        from blendjax.replay.shard_client import free_port
+
+        self.address = address or f"tcp://127.0.0.1:{free_port()}"
+        self.python = python or sys.executable
+        self.ready_timeout = ready_timeout
+        self._cmd = [
+            self.python, "-m", "blendjax.serve.server",
+            "--address", self.address,
+            "--model", model,
+            "--seed", str(seed),
+            "--obs-dim", str(obs_dim),
+            "--slots", str(slots),
+            "--length", str(length),
+            "--num-actions", str(num_actions),
+            "--tick-ms", str(tick_ms),
+            "--max-batch", str(max_batch),
+        ]
+        if window is not None:
+            self._cmd += ["--window", str(window)]
+        if int8:
+            self._cmd.append("--int8")
+        if serial:
+            self._cmd.append("--serial")
+        self._cmd += list(extra_args)
+        self.launch_info = None
+
+    def _spawn(self):
+        # one child-environment policy for the whole repo (launcher,
+        # shard fleet, serve server): child_env prepends the repo root
+        # to PYTHONPATH
+        from blendjax.btt.launcher import child_env
+
+        env = child_env()
+        # jax models pin to CPU in the child; a dead TPU tunnel relay
+        # must not hang server startup (same rationale as conftest)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        return subprocess.Popen(self._cmd, env=env,
+                                start_new_session=True)
+
+    def __enter__(self):
+        self.launch_info = _ServeLaunchInfo([self._spawn()],
+                                            [self.address])
+        try:
+            self.wait_ready(self.ready_timeout)
+        except BaseException:
+            self.close()
+            raise
+        return self
+
+    def wait_ready(self, timeout=60.0):
+        from blendjax.serve.client import ServeClient
+
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"policy server at {self.address} not ready within "
+                    f"{timeout:.1f}s"
+                )
+            client = ServeClient(self.address, timeoutms=500)
+            try:
+                client.hello(timeout_ms=500)
+                return
+            except TimeoutError:
+                continue
+            finally:
+                client.close()
+
+    def respawn(self, idx=0):
+        """Relaunch with the original command line (the watchdog's
+        contract)."""
+        proc = self._spawn()
+        self.launch_info.processes[idx] = proc
+        return proc
+
+    def close(self):
+        info = self.launch_info
+        if info is None:
+            return
+        for p in info.processes:
+            try:
+                p.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in info.processes:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# process entry point
+# ---------------------------------------------------------------------------
+
+
+def build_model(args):
+    """Deterministic model construction from CLI args (seeded init —
+    what makes a respawned server byte-identical to its predecessor)."""
+    if args.model == "linear":
+        return LinearModel(obs_dim=args.obs_dim, slots=args.slots,
+                           seed=args.seed)
+    import jax
+
+    key = jax.random.PRNGKey(args.seed)
+    if args.model == "policy":
+        from blendjax.models import policy
+
+        params = policy.init(key, args.obs_dim, args.num_actions)
+        return PolicyModel(params, args.obs_dim, int8=args.int8)
+    if args.model == "seqformer":
+        from blendjax.models import seqformer
+
+        params = seqformer.init(
+            key, obs_dim=args.obs_dim, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers,
+            max_len=max(args.length, 8),
+        )
+        return SeqFormerModel(
+            params, args.slots, args.length, window=args.window,
+            int8=args.int8,
+        )
+    raise ValueError(f"unknown --model {args.model!r}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Serve one blendjax policy/world-model."
+    )
+    ap.add_argument("--address", required=True)
+    ap.add_argument("--model", default="linear",
+                    choices=("linear", "policy", "seqformer"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--obs-dim", type=int, default=8)
+    ap.add_argument("--num-actions", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--length", type=int, default=64)
+    ap.add_argument("--window", type=int, default=None)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--serial", action="store_true")
+    ap.add_argument("--tick-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=64)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = PolicyServer(
+        args.address, build_model(args), serial=args.serial,
+        tick_ms=args.tick_ms, max_batch=args.max_batch,
+    )
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    logger.info(
+        "policy server (%s%s) serving %s", args.model,
+        ", int8" if args.int8 else "", server.address,
+    )
+    try:
+        server.serve_forever(stop_event=stop)
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

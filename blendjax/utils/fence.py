@@ -30,29 +30,52 @@ from __future__ import annotations
 
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
+# jax is imported lazily: this module rides in ``blendjax.utils``'s
+# public surface, which jax-free fast-start processes (replay shards,
+# the serve tier's LinearModel server) import for StageTimer — they
+# must not pay (or hang on, with a dead TPU tunnel relay) ``import
+# jax`` for fences they never call.
+_jit = None
 
-@jax.jit
-def _leaf_sum(leaves):
-    return sum(jnp.mean(leaf.astype(jnp.float32)) for leaf in leaves)
+
+def _fns():
+    global _jit
+    if _jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def leaf_sum(leaves):
+            return sum(
+                jnp.mean(leaf.astype(jnp.float32)) for leaf in leaves
+            )
+
+        @jax.jit
+        def fold(acc, leaves):
+            # one canonical reduction (jit inlines)
+            return acc + leaf_sum(leaves)
+
+        _jit = (leaf_sum, fold)
+    return _jit
+
+
+def _leaves(tree):
+    import jax
+
+    return [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
 
 
 def value_fence(tree):
     """Block until every leaf of ``tree`` is actually materialized on
     device, by fetching a scalar that depends on all of them.  Returns
     the fetched float (occasionally useful as a checksum)."""
-    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+    leaves = _leaves(tree)
     if not leaves:
         return 0.0
-    return float(np.asarray(_leaf_sum(leaves)))
-
-
-@jax.jit
-def _fold(acc, leaves):
-    return acc + _leaf_sum(leaves)  # one canonical reduction (jit inlines)
+    leaf_sum, _ = _fns()
+    return float(np.asarray(leaf_sum(leaves)))
 
 
 class fence_chain:
@@ -73,12 +96,15 @@ class fence_chain:
     """
 
     def __init__(self):
+        import jax.numpy as jnp
+
         self._acc = jnp.float32(0.0)
 
     def fold(self, tree):
-        leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+        leaves = _leaves(tree)
         if leaves:
-            self._acc = _fold(self._acc, leaves)
+            _, fold = _fns()
+            self._acc = fold(self._acc, leaves)
 
     def sync(self):
         """Fetch the accumulator — returns only when everything folded
@@ -95,6 +121,9 @@ def fences_valid(peak_flops_per_sec, n=2048, reps=2, slack=1.02):
     ``benchmarks/timing_calibration.py`` for the full chained-matmul
     calibration with value-fetch cross-checks.
     """
+    import jax
+    import jax.numpy as jnp
+
     x = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
     w = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
     mm = jax.jit(lambda a, b: a @ b)

@@ -118,6 +118,44 @@ REPLAY_EVENTS = (
     "replay_shard_journal", "replay_shard_lost",
 )
 
+#: Canonical policy-serving event names (see docs/serving.md).  Same
+#: contract as ``FLEET_EVENTS``: any ``EventCounters`` accepts them and
+#: the TelemetryHub zero-fills every name in every scrape.
+#: ``serve_requests`` — requests admitted (any command);
+#: ``serve_replies`` — replies sent (errors included);
+#: ``serve_batches`` — batched compute ticks executed;
+#: ``serve_batch_pad`` — padding rows added to reach a bucket size
+#: (wasted compute rows, the bucket/recompile tradeoff's price);
+#: ``serve_cache_hits`` — retried requests answered from the reply
+#: cache (exactly-once: no second decode for the same correlation id);
+#: ``serve_dup_inflight`` — duplicates of a still-queued request
+#: dropped at admission (the original's reply answers both);
+#: ``serve_resets`` — episodes admitted (slot allocations);
+#: ``serve_closes`` — episodes closed by their client;
+#: ``serve_evictions`` — idle slots reclaimed by the allocator;
+#: ``serve_slot_denied`` — resets refused because no slot was free;
+#: ``serve_errors`` — requests that errored: answered with an error
+#: reply, or (batched mode only) dropped because their frames were
+#: undecodable — the one case with no reply, healed by the client's
+#: retry.
+SERVE_EVENTS = (
+    "serve_requests", "serve_replies", "serve_batches",
+    "serve_batch_pad", "serve_cache_hits", "serve_dup_inflight",
+    "serve_resets", "serve_closes", "serve_evictions",
+    "serve_slot_denied", "serve_errors",
+)
+
+#: Canonical policy-serving stage names (see docs/serving.md), the
+#: :class:`StageTimer` vocabulary the serve benchmark and
+#: ``PolicyServer`` report under: ``queue_wait`` (request admission to
+#: batch dequeue — the continuous-batching latency price), and the tick
+#: processing: ``batch_assemble`` (drain + pad-to-bucket + host-side
+#: array build), ``compute`` (the jitted model call, fenced),
+#: ``reply`` (per-client scatter of the batch's replies).
+SERVE_STAGES = (
+    "queue_wait", "batch_assemble", "compute", "reply",
+)
+
 #: Canonical replay-path stage names (see docs/replay.md), the
 #: :class:`StageTimer` vocabulary the replay benchmark and
 #: ``ReplayBuffer`` report under: ``replay_append`` (row scatter into the
@@ -357,6 +395,24 @@ class StageTimer:
                 }
                 for name, total in self._total.items()
             }
+
+    def snapshot_serialized(self):
+        """:meth:`snapshot` with histograms serialized sparse
+        (``to_dict``) — the JSON-able ``stages`` shape a remote
+        ``telemetry`` RPC ships and ``TelemetryHub`` remotes merge.
+        One implementation for every wire-serving process (replay
+        shards, policy servers)."""
+        return {
+            name: {
+                "count": rec["count"],
+                "total_s": rec["total_s"],
+                "hist": (
+                    rec["hist"].to_dict()
+                    if rec["hist"] is not None else None
+                ),
+            }
+            for name, rec in self.snapshot().items()
+        }
 
     def export_chrome_trace(self, path):
         """Write recorded intervals as Chrome trace-event JSON

@@ -247,6 +247,31 @@ def recv_message_dealer(socket: zmq.Socket, flags: int = 0) -> dict:
     return decode(bufs)
 
 
+def recv_message_router(socket: zmq.Socket, flags: int = 0):
+    """Receive one DEALER client's request on a ROUTER socket: returns
+    ``(identity, message)`` where ``identity`` is the routing frame to
+    hand back to :func:`send_message_router`.  Strips the empty
+    delimiter :func:`send_message_dealer` framed with, so the same
+    clients speak to REP servers and ROUTER servers unmodified — the
+    many-clients half of the serving tier's continuous batching
+    (``blendjax/serve``)."""
+    frames = socket.recv_multipart(flags=flags, copy=True)
+    ident, body = frames[0], frames[1:]
+    if body and len(body[0]) == 0:
+        body = body[1:]
+    return ident, decode(body)
+
+
+def send_message_router(socket: zmq.Socket, ident: bytes, data: dict,
+                        raw_buffers: bool = False, flags: int = 0):
+    """Send ``data`` to the DEALER client behind routing frame
+    ``ident``, restoring the empty delimiter the client's
+    :func:`recv_message_dealer` strips."""
+    frames = encode(data, raw_buffers=raw_buffers)
+    socket.send_multipart([ident, b""] + frames, flags=flags,
+                          copy=False)
+
+
 def recv_message_raw(socket: zmq.Socket, flags: int = 0):
     """Receive without decoding; returns the raw frame list (bytes).
 

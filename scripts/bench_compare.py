@@ -11,9 +11,13 @@ into a guardrail::
 
 Each metric present in BOTH artifacts is compared as ``new / old``
 against its floor (see ``DEFAULT_FLOORS``; override per metric with
-``--floor metric=ratio``).  Any ratio below its floor is a regression:
-the offending rows are printed and the exit code is non-zero, so CI can
-gate on it.  Metrics present in only one artifact are listed as skipped
+``--floor metric=ratio``).  **Lower-is-better** metrics (latencies:
+``DEFAULT_CEILINGS``, e.g. ``serve_p99_ms``) invert the test — an
+*increase* past the ceiling is the regression (``--ceiling
+metric=ratio`` overrides or declares one).  Any violation is a
+regression: the offending rows are printed and the exit code is
+non-zero, so CI can gate on it.  Metrics present in only one artifact
+are listed as skipped
 — a new metric must not fail the diff retroactively, and a *vanished*
 metric is reported (``--strict`` turns vanished metrics into failures).
 
@@ -34,8 +38,8 @@ import json
 import re
 import sys
 
-#: metric -> minimum acceptable new/old ratio (all metrics here are
-#: higher-is-better).  Floors are loose enough for shared-CI noise on
+#: metric -> minimum acceptable new/old ratio (higher-is-better
+#: metrics).  Floors are loose enough for shared-CI noise on
 #: paired-window medians; tighten per-deployment via --floor.
 DEFAULT_FLOORS = {
     "value": 0.85,                  # headline images/sec
@@ -48,6 +52,16 @@ DEFAULT_FLOORS = {
     "rl_pipelined_x": 0.85,
     "rl_sharded_x": 0.80,
     "telemetry_overhead_x": 0.95,   # itself a ratio; must stay ~free
+    "serve_qps": 0.80,              # serving tier headline (docs/serving.md)
+    "serve_batch_x": 0.80,
+    "serve_int8_x": 0.80,
+}
+
+#: metric -> maximum acceptable new/old ratio for LOWER-is-better
+#: metrics: a ``serve_p99_ms`` *increase* is the regression, so the
+#: guardrail is a ceiling, not a floor.  Override via --ceiling.
+DEFAULT_CEILINGS = {
+    "serve_p99_ms": 1.30,           # tail latency; loopback-noise slack
 }
 
 #: fallback floor for numeric metrics named via --metrics that have no
@@ -70,9 +84,13 @@ def _json_lines(text):
     return out
 
 
+def _known_metrics():
+    return tuple(DEFAULT_FLOORS) + tuple(DEFAULT_CEILINGS)
+
+
 def _flatten(doc, metrics):
     """Fold one artifact dict's metric values into ``metrics``."""
-    for key in DEFAULT_FLOORS:
+    for key in _known_metrics():
         v = doc.get(key)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             metrics[key] = float(v)
@@ -94,6 +112,13 @@ def _flatten(doc, metrics):
             for k in ("replay_shard_x", "replay_degraded_x"):
                 if isinstance(shard.get(k), (int, float)):
                     metrics[k] = float(shard[k])
+    sb = doc.get("serve_bench")
+    if isinstance(sb, dict):
+        for k in ("serve_qps", "serve_p99_ms", "serve_batch_x",
+                  "serve_int8_x"):
+            if isinstance(sb.get(k), (int, float)) \
+                    and not isinstance(sb.get(k), bool):
+                metrics[k] = float(sb[k])
 
 
 def _regex_salvage(text, metrics):
@@ -101,7 +126,7 @@ def _regex_salvage(text, metrics):
     driver tails cut the single big line mid-JSON — e.g.
     ``BENCH_r04.json`` — so no line parses whole).  Structured values
     folded afterwards win over these."""
-    for metric in DEFAULT_FLOORS:
+    for metric in _known_metrics():
         hits = re.findall(
             rf'"{metric}":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)', text
         )
@@ -140,8 +165,16 @@ def extract_metrics(path):
     return metrics
 
 
-def compare(old, new, floors, strict=False):
-    """Row-per-metric comparison; returns (rows, regressions)."""
+def compare(old, new, floors, strict=False, ceilings=None):
+    """Row-per-metric comparison; returns (rows, regressions).
+
+    A metric in ``ceilings`` is LOWER-is-better: the regression test is
+    ``new/old <= ceiling`` (its row carries ``direction: "down"`` and
+    the bound under ``floor``).  Everything else keeps the
+    higher-is-better floor test.  A metric must not sit in both maps —
+    ``ceilings`` wins (it is the more specific declaration).
+    """
+    ceilings = DEFAULT_CEILINGS if ceilings is None else ceilings
     rows = []
     regressions = 0
     for metric in sorted(set(old) | set(new)):
@@ -156,13 +189,23 @@ def compare(old, new, floors, strict=False):
             if not ok:
                 regressions += 1
             continue
-        floor = floors.get(metric, FALLBACK_FLOOR)
+        lower_better = metric in ceilings
+        bound = (
+            ceilings[metric] if lower_better
+            else floors.get(metric, FALLBACK_FLOOR)
+        )
         ratio = (n / o) if o else None
-        ok = ratio is None or ratio >= floor
+        if ratio is None:
+            ok = True
+        elif lower_better:
+            ok = ratio <= bound
+        else:
+            ok = ratio >= bound
         rows.append({
             "metric": metric, "old": o, "new": n,
             "ratio": None if ratio is None else round(ratio, 3),
-            "floor": floor,
+            "floor": bound,
+            "direction": "down" if lower_better else "up",
             "status": "ok" if ok else "REGRESSION",
             "ok": ok,
         })
@@ -180,6 +223,11 @@ def main(argv=None):
         help="override a metric's regression floor (repeatable)",
     )
     ap.add_argument(
+        "--ceiling", action="append", default=[], metavar="METRIC=RATIO",
+        help="override (or declare) a LOWER-is-better metric's maximum "
+             "acceptable new/old ratio (repeatable)",
+    )
+    ap.add_argument(
         "--strict", action="store_true",
         help="a metric present in OLD but missing from NEW fails the diff",
     )
@@ -188,15 +236,31 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     floors = dict(DEFAULT_FLOORS)
+    ceilings = dict(DEFAULT_CEILINGS)
+    for spec in args.ceiling:
+        metric, _, ratio = spec.partition("=")
+        if not ratio:
+            ap.error(f"--ceiling needs METRIC=RATIO, got {spec!r}")
+        ceilings[metric] = float(ratio)
+    # floors validate against the FULLY-built ceilings map, so a metric
+    # declared lower-is-better on this very command line still refuses
+    # a floor (compare() consults ceilings first — the floor would be
+    # silently inert, faking a guardrail)
     for spec in args.floor:
         metric, _, ratio = spec.partition("=")
         if not ratio:
             ap.error(f"--floor needs METRIC=RATIO, got {spec!r}")
+        if metric in ceilings:
+            ap.error(
+                f"{metric} is lower-is-better; use --ceiling "
+                f"{metric}=RATIO"
+            )
         floors[metric] = float(ratio)
 
     old = extract_metrics(args.old)
     new = extract_metrics(args.new)
-    rows, regressions = compare(old, new, floors, strict=args.strict)
+    rows, regressions = compare(old, new, floors, strict=args.strict,
+                                ceilings=ceilings)
 
     if args.as_json:
         print(json.dumps({
@@ -210,10 +274,11 @@ def main(argv=None):
             o = "-" if r["old"] is None else f"{r['old']:.3f}"
             n = "-" if r["new"] is None else f"{r['new']:.3f}"
             ratio = "-" if r["ratio"] is None else f"{r['ratio']:.3f}"
+            kind = "ceiling" if r.get("direction") == "down" else "floor"
             floor = "-" if r["floor"] is None else f"{r['floor']:.2f}"
             print(
                 f"  {r['metric']:<{width}}  {o:>10} -> {n:>10}  "
-                f"x{ratio:>6} (floor {floor})  {r['status']}"
+                f"x{ratio:>6} ({kind} {floor})  {r['status']}"
             )
         if regressions:
             print(f"{regressions} regression(s) below floor")

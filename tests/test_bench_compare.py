@@ -150,6 +150,66 @@ def test_telemetry_overhead_floor_is_tight(tmp_path):
     assert bench_compare.main([old, new]) == 1
 
 
+SERVE_HEADLINE = {
+    "headline": True, "metric": "x_images_per_sec", "value": 100.0,
+    "serve_qps": 2650.0, "serve_p99_ms": 6.4, "serve_batch_x": 3.1,
+    "serve_int8_x": 0.98,
+}
+
+
+def test_serve_metrics_extract_from_headline_and_nest(tmp_path):
+    m = bench_compare.extract_metrics(
+        _write(tmp_path, "h.json", json.dumps(SERVE_HEADLINE))
+    )
+    assert m["serve_qps"] == 2650.0 and m["serve_p99_ms"] == 6.4
+    full = {
+        "metric": "m", "value": 80.0,
+        "serve_bench": {"serve_qps": 2600.0, "serve_p99_ms": 7.0,
+                        "serve_batch_x": 3.0, "serve_int8_x": 1.0},
+    }
+    m = bench_compare.extract_metrics(
+        _write(tmp_path, "f.json", json.dumps(full))
+    )
+    assert m["serve_batch_x"] == 3.0 and m["serve_p99_ms"] == 7.0
+
+
+def test_lower_is_better_ceiling_for_p99(tmp_path):
+    """serve_p99_ms inverts the verdict: a latency DROP passes however
+    large, and an increase past the ceiling is the regression — the
+    floor logic must not read a 2x latency jump as a 2x improvement."""
+    old = _write(tmp_path, "old.json", json.dumps(SERVE_HEADLINE))
+    better = dict(SERVE_HEADLINE, serve_p99_ms=2.0)   # x0.31: improvement
+    assert bench_compare.main(
+        [old, _write(tmp_path, "b.json", json.dumps(better))]
+    ) == 0
+    worse = dict(SERVE_HEADLINE, serve_p99_ms=12.8)   # x2.0 > 1.30 ceiling
+    assert bench_compare.main(
+        [old, _write(tmp_path, "w.json", json.dumps(worse))]
+    ) == 1
+    # --ceiling overrides per metric, like --floor does
+    assert bench_compare.main(
+        [old, _write(tmp_path, "w2.json", json.dumps(worse)),
+         "--ceiling", "serve_p99_ms=2.5"]
+    ) == 0
+
+
+def test_serve_qps_floor_guards_throughput(tmp_path):
+    old = _write(tmp_path, "old.json", json.dumps(SERVE_HEADLINE))
+    bad = dict(SERVE_HEADLINE, serve_qps=1500.0)  # x0.57 < 0.80 floor
+    assert bench_compare.main(
+        [old, _write(tmp_path, "bad.json", json.dumps(bad))]
+    ) == 1
+
+
+def test_direction_rides_json_rows(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", json.dumps(SERVE_HEADLINE))
+    assert bench_compare.main([old, old, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    by_metric = {r["metric"]: r for r in out["rows"]}
+    assert by_metric["serve_p99_ms"]["direction"] == "down"
+    assert by_metric["serve_qps"]["direction"] == "up"
+
+
 def test_unknown_file_raises(tmp_path):
     with pytest.raises(ValueError, match="no known bench metrics"):
         bench_compare.extract_metrics(

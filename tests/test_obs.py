@@ -38,6 +38,8 @@ from blendjax.utils.timing import (
     FLEET_EVENTS,
     REPLAY_EVENTS,
     REPLAY_STAGES,
+    SERVE_EVENTS,
+    SERVE_STAGES,
     EventCounters,
     StageTimer,
 )
@@ -203,16 +205,19 @@ def test_scrape_zero_fill_contract():
     hub = TelemetryHub()
     hub.register("fresh", counters=EventCounters(), timer=StageTimer())
     snap = hub.scrape()
-    for name in FLEET_EVENTS + REPLAY_EVENTS:
+    for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS:
         assert snap["counters"][name] == 0, name
-    for stage in FEED_STAGES + REPLAY_STAGES:
+    for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
     # ... and in the Prometheus rendering, without any event either
     prom = hub.to_prometheus(snap)
     assert 'blendjax_events_total{event="quarantines"} 0' in prom
+    assert 'blendjax_events_total{event="serve_cache_hits"} 0' in prom
     assert ('blendjax_stage_latency_seconds{stage="shard_gather",'
+            'quantile="0.99"} 0') in prom
+    assert ('blendjax_stage_latency_seconds{stage="queue_wait",'
             'quantile="0.99"} 0') in prom
 
 
@@ -678,6 +683,34 @@ def test_documented_stages_exist_in_tuples():
     assert not missing, f"documented but not in tuples: {missing}"
     # every canonical stage appears in the table
     absent = [n for n in vocab if n not in set(documented)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_serve_counters_exist_in_tuples():
+    """The serving tier's vocabulary lock (ISSUE-10 satellite): every
+    ``SERVE_EVENTS`` counter docs/serving.md tabulates exists in the
+    tuple, and every tuple name is tabulated — both directions, the
+    same contract the fleet/replay vocabularies keep."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "serving.md"),
+        "## Counter vocabulary",
+    )
+    vocab = set(SERVE_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_serve_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "serving.md"),
+        "## Stage vocabulary",
+    )
+    vocab = set(SERVE_STAGES)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
     assert not absent, f"in tuples but not tabulated: {absent}"
 
 

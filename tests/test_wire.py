@@ -77,3 +77,46 @@ def test_message_id_unique():
     # 8 bytes: the ids key the producer's exactly-once reply cache, so
     # collisions must stay negligible over multi-day kHz-rate runs
     assert all(len(i) == 16 for i in ids)
+
+
+@pytest.mark.parametrize("raw", [False, True])
+def test_dealer_router_roundtrip(raw):
+    """The serving tier's many-clients framing: the SAME dealer helpers
+    that speak to REP servers reach a ROUTER server, whose router
+    helpers strip/restore the empty delimiter per client identity."""
+    ctx = zmq.Context()
+    try:
+        router = ctx.socket(zmq.ROUTER)
+        port = router.bind_to_random_port("tcp://127.0.0.1")
+        dealers = [ctx.socket(zmq.DEALER) for _ in range(2)]
+        for i, d in enumerate(dealers):
+            d.connect(f"tcp://127.0.0.1:{port}")
+            wire.send_message_dealer(
+                d, {"who": i, "obs": np.arange(4, dtype=np.float32)},
+                raw_buffers=raw,
+            )
+        seen = {}
+        for _ in range(2):
+            assert router.poll(5000)
+            ident, msg = wire.recv_message_router(router)
+            seen[msg["who"]] = ident
+            np.testing.assert_array_equal(
+                msg["obs"], np.arange(4, dtype=np.float32)
+            )
+        assert seen[0] != seen[1]  # identities distinguish clients
+        # replies route back to the RIGHT client, in either encoding
+        for who, ident in seen.items():
+            wire.send_message_router(
+                router, ident,
+                {"who": who, "pred": np.full(3, who, np.float32)},
+                raw_buffers=raw,
+            )
+        for i, d in enumerate(dealers):
+            assert d.poll(5000)
+            out = wire.recv_message_dealer(d)
+            assert out["who"] == i
+            np.testing.assert_array_equal(
+                out["pred"], np.full(3, i, np.float32)
+            )
+    finally:
+        ctx.destroy(linger=0)
