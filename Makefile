@@ -10,8 +10,8 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
-	rlbench rlbench-sharded replaybench servebench multichip dryrun \
-	benchdiff obsdemo
+	rlbench rlbench-sharded replaybench servebench gatewaybench \
+	multichip dryrun benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -146,6 +146,20 @@ servebench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		$(PYTHON) benchmarks/serve_benchmark.py \
 		--seconds 18 --clients 8
+
+# Serve-fleet scale-out microbench (docs/serving.md "ServeGateway"): 3
+# linear-model replica processes (sleep-based --work-us per-row compute
+# stand-in, so replica compute is what scales) behind one ServeGateway,
+# 16 clients, interleaved 1-replica (others DRAINED) vs 3-replica
+# windows.  One JSON line with gateway_qps, gateway_p99_ms
+# (client-observed union p99) and gateway_scale_x (aggregate QPS at 3
+# replicas over 1 at the median pair; ~2.2 on the 2-core CI box — the
+# gap to 3.0 is the box's 2 cores carrying 16 GIL-bound bench clients
+# plus the single-threaded gateway hop).
+gatewaybench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) benchmarks/serve_benchmark.py \
+		--gateway --replicas 3 --seconds 18 --clients 16
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

@@ -375,11 +375,31 @@ def main():
             min(90, remaining),
         )
         serve_bench = serve_lines[-1] if serve_lines else None
+    # sixth configuration: the serve FLEET (docs/serving.md
+    # "ServeGateway") — 3 replica processes behind one gateway,
+    # interleaved 1-replica (drained) vs 3-replica windows:
+    # gateway_qps + gateway_p99_ms headline, gateway_scale_x the
+    # replica-level scale-out ratio.  Jax-free (linear replicas).
+    gateway_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 40:
+        gw_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "serve_benchmark.py"),
+                "--gateway", "--replicas", "3",
+                "--seconds", "15",
+                "--clients", "16",
+            ],
+            rl_env,
+            min(90, remaining),
+        )
+        gateway_bench = gw_lines[-1] if gw_lines else None
 
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
                    replay_bench=replay_bench, rl_sharded=rl_sharded,
-                   serve_bench=serve_bench)
+                   serve_bench=serve_bench, gateway_bench=gateway_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -424,10 +444,13 @@ HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
     ("serve_int8_x",),
+    ("serve_prefill_x",),
     ("replay_shard_x", "replay_degraded_x"),
     ("serve_batch_x",),
+    ("gateway_qps", "gateway_p99_ms"),
     ("rl_sharded_x",),
     ("replay_sample_x",),
+    ("gateway_scale_x",),
     ("serve_qps", "serve_p99_ms"),
     ("feed_arena_x",),
     ("rl_pipelined_x",),
@@ -486,6 +509,19 @@ def headline(out):
             line["serve_batch_x"] = sb["serve_batch_x"]
         if sb.get("serve_int8_x") is not None:
             line["serve_int8_x"] = sb["serve_int8_x"]
+        if sb.get("serve_prefill_x") is not None:
+            # batched prefill admission over T serial decode steps
+            line["serve_prefill_x"] = sb["serve_prefill_x"]
+    gb = out.get("gateway_bench")
+    if gb and gb.get("gateway_qps") is not None:
+        # the serve-FLEET headline: aggregate QPS through the gateway
+        # at 3 replicas, client-observed union p99, and the scale-out
+        # ratio vs the same fleet with all but one replica drained
+        line["gateway_qps"] = gb["gateway_qps"]
+        if gb.get("gateway_p99_ms") is not None:
+            line["gateway_p99_ms"] = gb["gateway_p99_ms"]
+        if gb.get("gateway_scale_x") is not None:
+            line["gateway_scale_x"] = gb["gateway_scale_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -538,7 +574,7 @@ def headline(out):
 
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
-             rl_sharded=None, serve_bench=None):
+             rl_sharded=None, serve_bench=None, gateway_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -553,10 +589,25 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
             for k in (
                 "model", "clients", "slots", "rounds", "window_s",
                 "serve_qps", "serve_p50_ms", "serve_p99_ms",
-                "serve_batch_x", "serve_int8_x", "serve_qps_modes",
+                "serve_batch_x", "serve_int8_x", "serve_prefill_x",
+                "prefill", "serve_qps_modes",
                 "pair_ratios", "stages",
             )
             if k in serve_bench
+        }
+    if gateway_bench and gateway_bench.get("phase") == "gateway_bench":
+        # the serve-fleet scale-out record: N replicas behind the
+        # gateway vs the same fleet drained to one — see
+        # benchmarks/serve_benchmark.py --gateway
+        extras["gateway_bench"] = {
+            k: gateway_bench[k]
+            for k in (
+                "replicas", "clients", "work_us", "rounds", "window_s",
+                "gateway_qps", "gateway_qps_1replica",
+                "gateway_p50_ms", "gateway_p99_ms", "gateway_scale_x",
+                "pair_ratios", "gateway_counters", "stages",
+            )
+            if k in gateway_bench
         }
     if feed_bound:
         # the feed ceiling, legacy vs arena assembly (trivial train step,

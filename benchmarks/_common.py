@@ -61,9 +61,33 @@ SERVE_BENCH_KEYS = (
     "episode_len",
     "serve_qps", "serve_p50_ms", "serve_p99_ms",
     "serve_batch_x", "serve_int8_x",
+    # batched prefill admission (reset with a T-step prefix replayed in
+    # ONE teacher-forced pass) vs T serial steps, median interleaved
+    # pair; None for stateless served models
+    "serve_prefill_x",
+    "prefill",           # the sub-record (prefix_len/admissions/rates)
     "serve_qps_modes",   # {"batched": .., "serial": .., "int8": ..}
     "pair_ratios",
     "stages",
+)
+
+#: Result-schema keys every ``serve_benchmark.py --gateway`` JSON line
+#: carries (phase ``gateway_bench``); ``bench.py`` keys off these and
+#: ``tests/test_gateway.py`` locks emission against this tuple.
+#: ``gateway_scale_x`` is the headline: aggregate QPS through the
+#: gateway at N replicas over the SAME fleet with all but one replica
+#: drained, at the median interleaved window pair;
+#: ``gateway_qps``/``gateway_p99_ms`` are the N-replica aggregate rate
+#: and client-observed union p99.
+GATEWAY_BENCH_KEYS = (
+    "replicas", "clients", "obs_dim", "work_us", "rounds", "window_s",
+    "episode_len",
+    "gateway_qps", "gateway_qps_1replica",
+    "gateway_p50_ms", "gateway_p99_ms",
+    "gateway_scale_x",
+    "pair_ratios",
+    "gateway_counters",
+    "stages",            # gw_route / gw_forward / gw_reply summaries
 )
 
 

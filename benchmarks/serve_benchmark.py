@@ -17,9 +17,26 @@ rounds (the drift-immune house scheme):
 
 Headline: ``serve_qps`` (median batched round) and ``serve_p99_ms``
 (client-observed per-request latency, merged across every batched
-round's per-client histograms — a real union quantile).  One JSON line;
-keys locked by ``benchmarks/_common.SERVE_BENCH_KEYS``.  See
-docs/serving.md.
+round's per-client histograms — a real union quantile).  A **prefill**
+phase prices batched prefill admission (``reset`` with a T-step
+observation prefix replayed in one teacher-forced pass) against T
+serial steps: ``serve_prefill_x`` = serial/prefill admission time at
+the median interleaved pair.  One JSON line; keys locked by
+``benchmarks/_common.SERVE_BENCH_KEYS``.
+
+``--gateway --replicas N`` switches to the **fleet** bench
+(``make gatewaybench``): N replica *processes* behind one in-process
+:class:`~blendjax.serve.gateway.ServeGateway`, measured over
+interleaved 1-replica vs N-replica windows — the 1-replica windows
+DRAIN all but replica 0 (the gateway's rolling-restart primitive doing
+double duty), so both arms run the same sockets, the same gateway hop
+and the same fleet, and the ratio isolates replica-level scale-out.
+``gateway_scale_x`` is the median per-pair ratio, ``gateway_qps`` /
+``gateway_p99_ms`` the N-replica aggregate QPS and client-observed
+union p99.  Replicas serve the linear model with a sleep-based per-row
+``--work-us`` compute stand-in (the RL bench's ``physics_us`` pattern)
+so replica compute — not the loopback wire — is the bottleneck being
+scaled; keys locked by ``GATEWAY_BENCH_KEYS``.  See docs/serving.md.
 """
 
 from __future__ import annotations
@@ -165,6 +182,67 @@ def _run_window(address, obs_dim, seconds, clients, episode_len):
     return sum(counts) / seconds, merged
 
 
+def _measure_prefill(address, obs_dim, *, prefix_len=32, admissions=4,
+                     pairs=2, seed=7):
+    """Batched prefill admission vs T serial steps: time ``admissions``
+    episode admissions with a ``prefix_len``-step observation prefix
+    through ``reset(prefix=...)`` (one teacher-forced pass) and through
+    ``reset()`` + T ``step()``s, in interleaved order-alternating
+    pairs.  Returns the prefill sub-record; ``serve_prefill_x`` is the
+    median per-pair serial/prefill time ratio (>1 = prefill wins)."""
+    from blendjax.serve.client import ServeClient
+
+    client = ServeClient(address, timeoutms=30000)
+    prefix = np.random.default_rng(seed).standard_normal(
+        (prefix_len, obs_dim)
+    ).astype(np.float32)
+
+    def admit_prefill():
+        client.reset(prefix=prefix)
+        client.close_episode()
+
+    def admit_serial():
+        client.reset()
+        for t in range(prefix_len):
+            client.step(prefix[t])
+        client.close_episode()
+
+    try:
+        # warm both arms (prefill compiles once per prefix length)
+        admit_prefill()
+        admit_serial()
+        t_pre, t_ser = [], []
+        for p in range(pairs):
+            arms = [admit_prefill, admit_serial]
+            sinks = [t_pre, t_ser]
+            if p % 2:
+                arms.reverse()
+                sinks.reverse()
+            for arm, sink in zip(arms, sinks):
+                t0 = time.perf_counter()
+                for _ in range(admissions):
+                    arm()
+                sink.append(time.perf_counter() - t0)
+    finally:
+        client.close()
+    ratios = [round(s / p, 3) for p, s in zip(t_pre, t_ser) if p > 0]
+    return {
+        "prefix_len": prefix_len,
+        "admissions": admissions,
+        "pairs": pairs,
+        "prefill_admits_per_sec": round(
+            admissions / float(np.median(t_pre)), 2
+        ),
+        "serial_admits_per_sec": round(
+            admissions / float(np.median(t_ser)), 2
+        ),
+        "pair_ratios": ratios,
+        "serve_prefill_x": (
+            round(float(np.median(ratios)), 3) if ratios else None
+        ),
+    }
+
+
 def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
             d_model=64, n_heads=4, n_layers=2, slots=None, length=64,
             episode_len=32, rounds=None, int8=True, seed=0,
@@ -214,6 +292,15 @@ def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
                 qps[name].append(rate)
                 if name == "batched":
                     batched_hist.merge(hist)
+        # prefill admission vs serial replay, on the live batched
+        # server (stateful models only — it needs a KV cache to fill)
+        prefill = (
+            _measure_prefill(
+                servers["batched"].address, obs_dim,
+                prefix_len=min(32, max(4, length // 2)),
+            )
+            if f_model.slots > 0 else None
+        )
     finally:
         for h in servers.values():
             h.close()
@@ -240,6 +327,10 @@ def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
             round(med["int8"] / med["batched"], 3)
             if int8 and med.get("batched") else None
         ),
+        "serve_prefill_x": (
+            prefill["serve_prefill_x"] if prefill else None
+        ),
+        "prefill": prefill,
         "serve_qps_modes": {k: round(v, 2) for k, v in med.items()},
         "pair_ratios": pair_ratios,
         "stages": {
@@ -248,6 +339,91 @@ def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
         },
     }
     return out
+
+
+def measure_gateway(seconds=18.0, clients=16, replicas=3, *, obs_dim=8,
+                    work_us=2000, episode_len=32, rounds=3, slots=None,
+                    seed=0, tick_ms=1.0, scrape_interval_s=0.2):
+    """The fleet bench: N linear-model replica processes behind one
+    in-process gateway, interleaved 1-replica (others DRAINED) vs
+    N-replica windows.  Returns the gateway_bench record."""
+    from blendjax.serve.gateway import start_gateway_thread
+    from blendjax.serve.server import ServerFleet
+    from blendjax.utils.timing import EventCounters, StageTimer
+
+    replicas = int(replicas)
+    slots = slots or max(2 * clients, 16)
+    window_s = max(0.5, seconds / (rounds * 2))
+    counters, timer = EventCounters(), StageTimer()
+    qps_one, qps_all = [], []
+    all_hist = LatencyHistogram()
+    with ServerFleet(replicas, model="linear", obs_dim=obs_dim,
+                     slots=slots, seed=seed, tick_ms=tick_ms,
+                     work_us=work_us) as fleet:
+        gw = start_gateway_thread(
+            fleet.addresses, counters=counters, timer=timer,
+            scrape_interval_s=scrape_interval_s,
+        )
+        rest = [f"r{i}" for i in range(1, replicas)]
+
+        def run_one():
+            # drain everything but r0: same gateway, same sockets,
+            # same fleet — only the replica count differs
+            for rid in rest:
+                gw.gateway.drain(rid)
+            time.sleep(0.05)  # let in-flight resets settle
+            try:
+                rate, _ = _run_window(gw.address, obs_dim, window_s,
+                                      clients, episode_len)
+            finally:
+                for rid in rest:
+                    gw.gateway.undrain(rid)
+            return rate
+
+        def run_all():
+            rate, hist = _run_window(gw.address, obs_dim, window_s,
+                                     clients, episode_len)
+            all_hist.merge(hist)
+            return rate
+
+        try:
+            _run_window(gw.address, obs_dim, 0.3, clients, episode_len)
+            for r in range(rounds):
+                if r % 2 == 0:
+                    qps_one.append(run_one())
+                    qps_all.append(run_all())
+                else:
+                    qps_all.append(run_all())
+                    qps_one.append(run_one())
+        finally:
+            gw.close()
+    pairs = [round(n / o, 3) for o, n in zip(qps_one, qps_all) if o]
+    pct = all_hist.percentiles()
+    return {
+        "replicas": replicas,
+        "clients": clients,
+        "obs_dim": obs_dim,
+        "work_us": work_us,
+        "rounds": rounds,
+        "window_s": round(window_s, 3),
+        "episode_len": episode_len,
+        "gateway_qps": round(float(np.median(qps_all)), 2),
+        "gateway_qps_1replica": round(float(np.median(qps_one)), 2),
+        "gateway_p50_ms": pct["p50_ms"],
+        "gateway_p99_ms": pct["p99_ms"],
+        "gateway_scale_x": (
+            round(float(np.median(pairs)), 3) if pairs else None
+        ),
+        "pair_ratios": pairs,
+        "gateway_counters": {
+            k: v for k, v in counters.snapshot().items()
+            if k.startswith("gateway_")
+        },
+        "stages": {
+            k: v for k, v in timer.summary().items()
+            if k in ("gw_route", "gw_forward", "gw_reply")
+        },
+    }
 
 
 def main(argv=None):
@@ -267,7 +443,30 @@ def main(argv=None):
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--no-int8", dest="int8", action="store_false")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--gateway", action="store_true",
+                    help="fleet bench: N replica processes behind a "
+                         "ServeGateway, 1-replica vs N-replica windows")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--work-us", type=float, default=2000,
+                    help="gateway bench: per-row replica compute "
+                         "stand-in (sleep-based, linear model)")
     args = ap.parse_args(argv)
+    if args.gateway:
+        rec = measure_gateway(
+            seconds=args.seconds, clients=args.clients,
+            replicas=args.replicas, obs_dim=args.obs_dim,
+            work_us=args.work_us, episode_len=args.episode_len,
+            rounds=args.rounds or 3, seed=args.seed,
+        )
+        line = {
+            "metric": "gateway_qps",
+            "value": rec["gateway_qps"],
+            "unit": "req/sec",
+            "phase": "gateway_bench",
+            **rec,
+        }
+        print(json.dumps(line), flush=True)
+        return 0
     rec = measure(
         seconds=args.seconds, clients=args.clients, model=args.model,
         obs_dim=args.obs_dim, d_model=args.d_model,

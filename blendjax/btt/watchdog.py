@@ -34,13 +34,22 @@ class FleetWatchdog:
         thread).
     restart: bool
         Respawn dead instances with their original command.
+    on_respawn: callable | None
+        ``on_respawn(index, process)`` invoked after a SUCCESSFUL respawn
+        (from the watchdog thread; only fires with ``restart=True``).
+        ``on_death`` reports the loss; this reports the replacement — a
+        consumer that probes/re-admits (the serve gateway, a supervisor
+        heal loop) re-arms immediately instead of waiting out its next
+        poll.
     """
 
-    def __init__(self, launcher, interval=1.0, on_death=None, restart=False):
+    def __init__(self, launcher, interval=1.0, on_death=None, restart=False,
+                 on_respawn=None):
         self.launcher = launcher
         self.interval = interval
         self.on_death = on_death
         self.restart = restart
+        self.on_respawn = on_respawn
         self.deaths = []  # (index, exit_code, restarted)
         self._stop = threading.Event()
         self._thread = None
@@ -130,5 +139,15 @@ class FleetWatchdog:
                     except Exception:
                         logger.exception(
                             "watchdog on_death callback failed for "
+                            "instance %d (watchdog keeps running)", idx,
+                        )
+                if restarted and self.on_respawn is not None:
+                    # after on_death: the loss is reported before the
+                    # replacement (same survival contract)
+                    try:
+                        self.on_respawn(idx, new)
+                    except Exception:
+                        logger.exception(
+                            "watchdog on_respawn callback failed for "
                             "instance %d (watchdog keeps running)", idx,
                         )

@@ -7,9 +7,10 @@ buffer, per shard process.  The hub merges them on demand into one
 snapshot:
 
 - :meth:`TelemetryHub.scrape` — a JSON-able dict with every canonical
-  counter (``FLEET_EVENTS`` + ``REPLAY_EVENTS`` + ``SERVE_EVENTS``) and
-  every canonical stage (``FEED_STAGES`` + ``REPLAY_STAGES`` +
-  ``SERVE_STAGES``) **zero-filled** (the same
+  counter (``FLEET_EVENTS`` + ``REPLAY_EVENTS`` + ``SERVE_EVENTS`` +
+  ``GATEWAY_EVENTS``) and every canonical stage (``FEED_STAGES`` +
+  ``REPLAY_STAGES`` + ``SERVE_STAGES`` + ``GATEWAY_STAGES``)
+  **zero-filled** (the same
   contract ``FleetSupervisor.health()`` keeps: dashboards and tests
   need no existence checks), histograms merged across components so the
   aggregate p99 is a real quantile of the union, not a mean of means;
@@ -48,14 +49,14 @@ def _canonical_counters():
     from blendjax.utils import timing
 
     return (timing.FLEET_EVENTS + timing.REPLAY_EVENTS
-            + timing.SERVE_EVENTS)
+            + timing.SERVE_EVENTS + timing.GATEWAY_EVENTS)
 
 
 def _canonical_stages():
     from blendjax.utils import timing
 
     return (timing.FEED_STAGES + timing.REPLAY_STAGES
-            + timing.SERVE_STAGES)
+            + timing.SERVE_STAGES + timing.GATEWAY_STAGES)
 
 
 def _zero_stage():

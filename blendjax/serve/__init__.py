@@ -12,10 +12,18 @@ holds a row in a **KV-cache slot pool** decoded at per-row positions
 the ``wire.BTMID_KEY`` reply cache; ``--int8`` serves the
 ``ops/quant``-quantized model through the same code.
 
+A fleet of replicas scales the tier out behind a
+:class:`~blendjax.serve.gateway.ServeGateway` (ROUTER front, per-replica
+DEALER backends): episode-lease affinity pins an episode's steps to the
+replica owning its KV-cache row, fresh episodes spread by scraped load,
+and a SIGKILLed replica respawned by the watchdog costs its episodes
+one actionable stale-lease error before they resume via ``reset()``.
+
 Public surface::
 
     from blendjax.serve import (
         PolicyServer, ServeClient, ServeRPCError, ServerProcess,
+        ServerFleet, ServeGateway, start_gateway_thread,
         LinearModel, PolicyModel, SeqFormerModel, start_server_thread,
     )
 
@@ -31,10 +39,13 @@ _EXPORTS = {
     "PolicyModel": "blendjax.serve.server",
     "SeqFormerModel": "blendjax.serve.server",
     "ServerProcess": "blendjax.serve.server",
+    "ServerFleet": "blendjax.serve.server",
     "start_server_thread": "blendjax.serve.server",
     "default_buckets": "blendjax.serve.server",
     "ServeClient": "blendjax.serve.client",
     "ServeRPCError": "blendjax.serve.client",
+    "ServeGateway": "blendjax.serve.gateway",
+    "start_gateway_thread": "blendjax.serve.gateway",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -48,7 +48,7 @@ class ServeClient:
 
     def __init__(self, address, *, fault_policy=None, counters=None,
                  timeoutms=5000, context=None, span_recorder=None,
-                 name="serve"):
+                 name="serve", model=None):
         import zmq
 
         self.address = address
@@ -59,6 +59,14 @@ class ServeClient:
         self.timeoutms = int(timeoutms)
         self.slot = None  # the live episode's slot after reset()
         self.episode = None  # ... and its lease id (see reset())
+        #: model id this client's episodes run on (multi-model servers
+        #: / gateway routing); None = the server's default model
+        self.model = model
+        #: the replica id that served the LAST reply (stamped by a
+        #: ServeGateway; None against a bare server) — surfaced in
+        #: ServeRPCError text and span args so a misbehaving replica is
+        #: diagnosable from a client traceback alone
+        self.replica = None
         #: cross-process span sink (None = tracing off): client RPC
         #: spans plus the server's piggybacked serve-side spans
         self.spans = span_recorder
@@ -95,7 +103,12 @@ class ServeClient:
 
         msg = dict(payload or {})
         msg["cmd"] = cmd
-        return exactly_once_rpc(
+        # the last replica that answered (gateway-stamped) rides the
+        # transport-error text and the client span: when a fleet
+        # misbehaves, the traceback names the suspect replica
+        via = (f", last replica {self.replica}"
+               if self.replica is not None else "")
+        reply = exactly_once_rpc(
             self._socket, msg,
             policy=self.policy, state=self.state,
             counters=self.counters,
@@ -104,27 +117,52 @@ class ServeClient:
             raw_buffers=raw_buffers, spans=self.spans,
             remote_name="policy server",
             span_label="serve_rpc", span_cat="serve_client",
+            span_args=({"replica": self.replica}
+                       if self.replica is not None else None),
             rpc_name=f"{self.name}:{cmd}",
             exc_factory=lambda text: ServeRPCError(
-                f"policy server ({self.address}): {text}"
+                f"policy server ({self.address}{via}): {text}"
             ),
             retryable=(ServeRPCError,),
             pop_mid=True,
         )
+        rep = reply.get("replica")
+        if rep is not None:
+            self.replica = rep
+        return reply
 
     # -- episode protocol ----------------------------------------------------
 
     def hello(self, timeout_ms=None):
         return self.rpc("hello", timeout_ms=timeout_ms)
 
-    def reset(self, timeout_ms=None):
+    def _model_payload(self, payload):
+        if self.model is not None:
+            payload["model"] = self.model
+        return payload
+
+    def reset(self, prefix=None, timeout_ms=None):
         """Admit an episode: returns (and remembers) its slot id.  The
         reply's episode *lease* id rides every later step/close, so a
         slot the server evicted and reassigned refuses this client's
-        stale steps instead of advancing the new tenant's cache."""
-        reply = self.rpc("reset", timeout_ms=timeout_ms)
+        stale steps instead of advancing the new tenant's cache.
+
+        ``prefix`` — a ``(T, obs_dim)`` observation prefix — admits the
+        episode MID-SEQUENCE: the server replays it in one
+        teacher-forced batched pass (not T serial decodes) and the full
+        reply dict is returned instead of the slot, with ``pred`` (the
+        prediction for position T) and ``pos`` (the position the next
+        ``step`` consumes)."""
+        payload = self._model_payload({})
+        if prefix is not None:
+            payload["prefix"] = np.asarray(prefix, np.float32)
+        reply = self.rpc("reset", payload, timeout_ms=timeout_ms,
+                         raw_buffers=prefix is not None)
         self.slot = int(reply["slot"])
         self.episode = reply.get("episode")
+        if prefix is not None:
+            reply["pred"] = np.asarray(reply["pred"])
+            return reply
         return self.slot
 
     def step(self, obs, slot=None, timeout_ms=None):
@@ -136,8 +174,10 @@ class ServeClient:
             raise RuntimeError("step() before reset(): no episode slot")
         reply = self.rpc(
             "step",
-            {"slot": int(use), "episode": self.episode,
-             "obs": np.asarray(obs, np.float32)},
+            self._model_payload(
+                {"slot": int(use), "episode": self.episode,
+                 "obs": np.asarray(obs, np.float32)}
+            ),
             timeout_ms=timeout_ms, raw_buffers=True,
         )
         reply["pred"] = np.asarray(reply["pred"])
@@ -147,7 +187,10 @@ class ServeClient:
         if self.slot is None:
             return False
         reply = self.rpc(
-            "close", {"slot": self.slot, "episode": self.episode},
+            "close",
+            self._model_payload(
+                {"slot": self.slot, "episode": self.episode}
+            ),
             timeout_ms=timeout_ms,
         )
         self.slot = None

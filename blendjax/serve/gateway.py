@@ -1,0 +1,930 @@
+"""ServeGateway: a routed, supervised fleet of policy servers.
+
+PR 10 built one :class:`~blendjax.serve.server.PolicyServer`; the
+north star ("heavy traffic from millions of users") needs a *fleet*:
+N replicas behind one routing front that keeps aggregate QPS scaling
+near-linearly while a replica dies and respawns (the replica-level
+scale-out half of the TPU serving playbook, arXiv:2605.25645, on top
+of PR 10's batch admission).  The gateway is one process/thread with a
+client-facing ROUTER socket and one DEALER backend per replica:
+
+- **episode-lease affinity**: the ``{slot, episode}`` lease every reset
+  reply already carries becomes the session token.  The gateway rewrites
+  the replica's episode id to a gateway-unique lease id, remembers
+  ``lease -> (replica, slot, real episode)``, and pins every later
+  ``step``/``close`` of that episode to the replica that owns its
+  KV-cache row (``gateway_affinity_hits``).  Lease ids are never reused
+  across replica incarnations, so a respawned replica can never be
+  reached through a dead episode's lease;
+- **load-spread fresh episodes**: each replica's ``telemetry`` RPC is
+  scraped on an interval (cheap and cached — never per-request) for
+  queue depth, live episodes and the ``SERVE_STAGES`` ``queue_wait``
+  p99; a ``reset`` goes to the lowest-scoring healthy, non-draining
+  replica (rotation breaks ties; ``gateway_rebalances`` counts the
+  routes where load overrode rotation).  Between scrapes an optimistic
+  local live-count keeps a burst of resets spreading instead of piling
+  onto the last scrape's winner;
+- **supervision**: replicas live under the existing
+  :class:`~blendjax.btt.watchdog.FleetWatchdog`/:class:`~blendjax.serve.
+  server.ServerFleet` vocabulary.  A replica that stops answering
+  scrapes (or whose death the watchdog reports via
+  :meth:`ServeGateway.notify_replica_death`) is **quarantined**: its
+  leases are invalidated, steps against them get the actionable
+  stale-lease error (``gateway_stale_lease_redirects``) and resume
+  after ``reset()`` on a healthy replica; the respawned replica rejoins
+  on its first answered scrape (``gateway_replica_respawns``).
+  :meth:`ServeGateway.drain` stops fresh episodes to a replica while
+  its live episodes finish — the rolling-restart primitive;
+- **exactly-once through the extra hop**: the gateway forwards
+  ``wire.BTMID_KEY`` verbatim, re-forwards a retry of an in-flight
+  request to the SAME replica (whose dedupe/reply cache keeps it
+  exactly-once), and keeps its own bounded reply cache of mutating
+  replies so a retry whose reply was lost between gateway and client is
+  answered without touching the fleet again.  The client-side
+  discipline (:func:`blendjax.btt.rpc.exactly_once_rpc`) rides through
+  unchanged;
+- **multi-model routing**: requests carrying ``model`` in the envelope
+  route only to replicas hosting that model id (learned from the
+  scrape), composing with the server-side multi-model hosting
+  (per-model slot pools and bucket caches — see server.py).
+
+Every forwarded reply is stamped with the serving replica's id
+(``replica``), so a misbehaving replica is diagnosable from a client
+traceback alone (``ServeClient`` surfaces it in ``ServeRPCError`` text
+and span args).
+
+Telemetry: ``GATEWAY_EVENTS`` counters + ``GATEWAY_STAGES``
+(``gw_route``/``gw_forward``/``gw_reply``) with latency histograms,
+zero-filled by every ``TelemetryHub.scrape()``; the gateway answers the
+``telemetry`` RPC itself, so ``ServeClient.register_with_hub`` makes it
+a scrapeable remote like any replica.
+
+Run a gateway as a process::
+
+    python -m blendjax.serve.gateway --address tcp://127.0.0.1:24100 \
+        --replica tcp://127.0.0.1:24000 --replica tcp://127.0.0.1:24001
+
+or in-process via :func:`start_gateway_thread`.  See docs/serving.md
+("ServeGateway").
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import threading
+import time
+from collections import OrderedDict, deque
+
+from blendjax import wire
+from blendjax.obs.histogram import LatencyHistogram
+from blendjax.obs.spans import make_span, now_us
+from blendjax.serve.server import (
+    MUTATING_CMDS,
+    REPLY_CACHE_DEPTH,
+    drain_socket,
+)
+from blendjax.utils.timing import StageTimer, fleet_counters
+
+logger = logging.getLogger("blendjax")
+
+#: Bound on the in-flight route table (mid -> client ident + replica).
+#: Routes pop when their reply forwards; entries past the bound are the
+#: leftovers of clients that gave up — evicted oldest-first.
+ROUTE_CACHE_DEPTH = 8192
+
+#: Commands the gateway answers itself (never forwarded): aggregate
+#: capability/stats/telemetry plus the drain lifecycle.
+GATEWAY_CMDS = ("hello", "stats", "telemetry", "drain", "undrain")
+
+
+class _Replica:
+    """One backend replica: its DEALER channel plus the cached scrape
+    state the router decides with."""
+
+    __slots__ = (
+        "id", "address", "sock", "healthy", "draining", "models",
+        "queued", "live", "p99_ms", "pending_live", "last_ok",
+        "incarnation", "scrape_mid", "scrape_sent", "next_scrape", "pid",
+        "caps",
+    )
+
+    def __init__(self, rid, address, sock, now):
+        self.id = rid
+        self.address = address
+        self.sock = sock
+        self.healthy = True
+        self.draining = False
+        self.models = None     # None until the first scrape: matches any
+        self.queued = 0
+        self.live = 0
+        self.p99_ms = 0.0
+        #: fresh episodes routed here since the last scrape — the
+        #: optimistic estimate that keeps a reset burst spreading
+        self.pending_live = 0
+        self.last_ok = now     # construction grace: one quarantine window
+        self.incarnation = 0
+        self.scrape_mid = None
+        self.scrape_sent = 0.0
+        self.next_scrape = 0.0  # scrape immediately on loop start
+        self.pid = None
+        self.caps = None  # PR-10 capability fields from the scrape
+
+    def hosts(self, model):
+        return model is None or self.models is None or model in self.models
+
+    def load_score(self):
+        """Routing score, lower = preferred: live episodes (capacity),
+        queue depth (overload, weighted — queued work is latency NOW)
+        and the scraped ``queue_wait`` p99 as a slow-replica penalty."""
+        return (self.live + self.pending_live + 4 * self.queued
+                + self.p99_ms / 100.0)
+
+    def snapshot(self):
+        return {
+            "address": self.address,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "models": sorted(self.models) if self.models else None,
+            "queued": self.queued,
+            "live_episodes": self.live,
+            "p99_ms": round(self.p99_ms, 3),
+            "incarnation": self.incarnation,
+            "pid": self.pid,
+        }
+
+
+class _Lease:
+    __slots__ = ("rid", "slot", "episode", "model", "incarnation",
+                 "dead", "t_use")
+
+    def __init__(self, rid, slot, episode, model, incarnation):
+        self.rid = rid
+        self.slot = slot
+        self.episode = episode  # the replica's REAL lease id
+        self.model = model
+        self.incarnation = incarnation
+        self.dead = False
+        self.t_use = time.monotonic()
+
+
+class _Route:
+    __slots__ = ("ident", "rid", "inc", "cmd", "model", "gw_ep", "t0",
+                 "span_trace", "t0_us")
+
+    def __init__(self, ident, rid, inc, cmd, model, gw_ep, span_trace,
+                 t0_us):
+        self.ident = ident
+        self.rid = rid
+        self.inc = inc  # replica incarnation at forward time
+        self.cmd = cmd
+        self.model = model
+        self.gw_ep = gw_ep  # the client-visible lease id (step/close)
+        self.t0 = time.perf_counter()
+        self.span_trace = span_trace
+        self.t0_us = t0_us
+
+
+class ServeGateway:
+    """The routing front of a policy-server fleet (module docstring).
+
+    Params
+    ------
+    address: str
+        Client-facing endpoint to bind (``tcp://host:*`` binds an
+        ephemeral port; resolved endpoint on :attr:`address`).
+    replicas: sequence[str]
+        Backend replica addresses; replica ids are ``r0..rN-1`` in
+        order.
+    scrape_interval_s: float
+        Cached load/liveness scrape period per replica (the routing
+        table refresh — never per-request).
+    quarantine_after_s: float | None
+        Silence horizon after which a replica is quarantined (default
+        ``max(1.0, 4 * scrape_interval_s)``).
+    lease_ttl_s: float | None
+        Idle horizon after which a lease is forgotten (default 600 s;
+        None disables).  A client that crashes without ``close()``
+        leaves its lease behind — the replica reclaims the slot via its
+        own ``slot_ttl_s``, but the gateway only learns through this
+        sweep (the scrape carries counts, not slot identities).  A
+        pruned lease's late step gets the same actionable
+        reset-and-resume error as a stale one.
+    """
+
+    def __init__(self, address, replicas, *, scrape_interval_s=0.25,
+                 quarantine_after_s=None, lease_ttl_s=600.0,
+                 counters=None, timer=None,
+                 reply_cache_depth=REPLY_CACHE_DEPTH, context=None):
+        import zmq
+
+        if not replicas:
+            raise ValueError("a gateway needs >= 1 replica address")
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.quarantine_after_s = (
+            max(1.0, 4 * self.scrape_interval_s)
+            if quarantine_after_s is None else float(quarantine_after_s)
+        )
+        self.lease_ttl_s = (
+            None if lease_ttl_s is None else float(lease_ttl_s)
+        )
+        self._next_lease_sweep = 0.0
+        self.counters = counters if counters is not None else fleet_counters
+        self.timer = timer if timer is not None else StageTimer()
+        self._ctx = context or zmq.Context.instance()
+        self._front = self._ctx.socket(zmq.ROUTER)
+        self._front.setsockopt(zmq.LINGER, 0)
+        if address.endswith(":*") or address.endswith(":0"):
+            base = address.rsplit(":", 1)[0]
+            port = self._front.bind_to_random_port(base)
+            self.address = f"{base}:{port}"
+        else:
+            self._front.bind(address)
+            self.address = address
+        now = time.monotonic()
+        self._replicas = {}
+        for i, addr in enumerate(replicas):
+            sock = self._ctx.socket(zmq.DEALER)
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.connect(addr)
+            self._replicas[f"r{i}"] = _Replica(f"r{i}", addr, sock, now)
+        self._order = list(self._replicas)
+        self._rr = 0
+        self._routes = OrderedDict()   # mid -> _Route (in flight)
+        self._scrapes = {}             # mid -> replica id
+        self._leases = {}              # gw episode id -> _Lease
+        self._lease_rev = {}           # (rid, incarnation, real ep) -> gw ep
+        self._ep_seq = 0
+        self._reply_cache = OrderedDict()
+        self._reply_cache_depth = int(reply_cache_depth)
+        #: watchdog notices (thread-safe appends), applied on the loop
+        self._notices = deque()
+
+    # -- admin (callable from any thread; applied under the GIL) -------------
+
+    def drain(self, rid):
+        """Stop routing FRESH episodes to ``rid``; its live episodes
+        keep stepping until they close — the rolling-restart primitive."""
+        rep = self._replicas[rid]
+        if not rep.draining:
+            rep.draining = True
+            self.counters.incr("gateway_drains")
+        return True
+
+    def undrain(self, rid):
+        self._replicas[rid].draining = False
+        return True
+
+    def notify_replica_death(self, idx_or_rid, exit_code=None):
+        """Watchdog ``on_death`` hook: quarantine the replica NOW
+        instead of waiting out the scrape silence horizon."""
+        self._notices.append(("death", self._rid(idx_or_rid)))
+
+    def notify_replica_respawn(self, idx_or_rid, proc=None):
+        """Watchdog ``on_respawn`` hook: probe the replica immediately
+        so re-admission does not wait for the next scheduled scrape."""
+        self._notices.append(("respawn", self._rid(idx_or_rid)))
+
+    def _rid(self, idx_or_rid):
+        return (idx_or_rid if isinstance(idx_or_rid, str)
+                else f"r{int(idx_or_rid)}")
+
+    def _apply_notices(self):
+        while self._notices:
+            kind, rid = self._notices.popleft()
+            rep = self._replicas.get(rid)
+            if rep is None:
+                continue
+            if kind == "death":
+                self._quarantine(rep)
+            else:  # respawn: probe now
+                rep.next_scrape = 0.0
+
+    # -- lease + quarantine bookkeeping --------------------------------------
+
+    def _drop_lease(self, gw_ep):
+        lease = self._leases.pop(gw_ep, None)
+        if lease is not None:
+            self._lease_rev.pop(
+                (lease.rid, lease.incarnation, lease.episode), None
+            )
+
+    def _quarantine(self, rep):
+        if not rep.healthy:
+            return
+        rep.healthy = False
+        rep.incarnation += 1
+        rep.pending_live = 0
+        rep.queued = 0
+        rep.live = 0
+        for lease in self._leases.values():
+            if lease.rid == rep.id:
+                # kept (marked) rather than dropped so the episode's
+                # next step gets the SPECIFIC stale-lease error naming
+                # the dead replica, not a generic unknown-lease one
+                lease.dead = True
+        self.counters.incr("gateway_replica_quarantined")
+        logger.warning("gateway: replica %s (%s) quarantined",
+                       rep.id, rep.address)
+
+    # -- scrape loop ---------------------------------------------------------
+
+    def _scrape_tick(self):
+        import zmq
+
+        now = time.monotonic()
+        for rep in self._replicas.values():
+            if rep.scrape_mid is not None and \
+                    now - rep.scrape_sent > self.scrape_interval_s * 2:
+                # scrape lost (dead replica or drop): give up on the
+                # mid so the next interval re-probes
+                self._scrapes.pop(rep.scrape_mid, None)
+                rep.scrape_mid = None
+            if rep.scrape_mid is None and now >= rep.next_scrape:
+                msg = {"cmd": "telemetry"}
+                mid = wire.stamp_message_id(msg)
+                try:
+                    # DONTWAIT: a dead replica's pipe must not fill up
+                    # with scrapes and block the gateway loop — the
+                    # silence horizon quarantines it instead
+                    wire.send_message_dealer(rep.sock, msg,
+                                             flags=zmq.DONTWAIT)
+                except zmq.ZMQError:  # Again included: skip this round
+                    continue
+                rep.scrape_mid = mid
+                rep.scrape_sent = now
+                rep.next_scrape = now + self.scrape_interval_s
+                self._scrapes[mid] = rep.id
+            if rep.healthy and now - rep.last_ok > self.quarantine_after_s:
+                self._quarantine(rep)
+        if self.lease_ttl_s is not None and now >= self._next_lease_sweep:
+            # abandoned-episode sweep: a client that crashed without
+            # close() must not leak a lease forever (the replica
+            # reclaims the slot via slot_ttl_s; this is the gateway's
+            # analogue).  Swept on the scrape cadence, amortized.
+            self._next_lease_sweep = now + max(1.0, self.lease_ttl_s / 4)
+            cutoff = now - self.lease_ttl_s
+            for gw_ep in [ep for ep, lease in self._leases.items()
+                          if lease.t_use < cutoff]:
+                self._drop_lease(gw_ep)
+
+    def _ingest_scrape(self, rep, reply):
+        rep.last_ok = time.monotonic()
+        rep.scrape_mid = None
+        pid = reply.get("pid")
+        if rep.healthy and rep.pid is not None and pid is not None \
+                and pid != rep.pid:
+            # SILENT restart: the replica answered a new pid without
+            # ever missing a scrape (external restart, or a respawn
+            # faster than the quarantine horizon).  Its slot pool is
+            # fresh — old leases must die NOW, and the incarnation must
+            # bump so the new process's recycled (slot, episode) pairs
+            # cannot alias old gateway leases through _lease_rev
+            self._quarantine(rep)
+        if not rep.healthy:
+            rep.healthy = True
+            self.counters.incr("gateway_replica_respawns")
+            logger.warning("gateway: replica %s answered again — "
+                           "re-admitted", rep.id)
+        models = reply.get("models")
+        if models:
+            rep.models = set(models)
+        rep.queued = int(reply.get("queued", 0))
+        rep.live = int(reply.get("live_episodes", 0))
+        rep.pending_live = 0  # the scrape's live count subsumes it
+        rep.pid = pid
+        caps = reply.get("hello")
+        if isinstance(caps, dict):
+            rep.caps = caps
+        stages = reply.get("stages") or {}
+        rec = stages.get("queue_wait") or {}
+        hist = rec.get("hist")
+        if hist:
+            try:
+                rep.p99_ms = LatencyHistogram.from_dict(
+                    hist
+                ).percentiles()["p99_ms"]
+            except Exception:  # noqa: BLE001 - scrape must not kill routing
+                pass
+
+    # -- gateway-level commands ----------------------------------------------
+
+    def _cmd_hello(self, msg):
+        models = set()
+        caps = None
+        for rep in self._replicas.values():
+            models |= rep.models or set()
+            if caps is None and rep.healthy and rep.caps is not None:
+                caps = rep.caps
+        out = {}
+        if caps is not None:
+            # a representative replica's PR-10 capability fields
+            # (obs_dim, slots, max_batch, buckets, int8, serial, model)
+            # so hello consumers written against a bare server work
+            # unchanged pointed at a gateway
+            out.update(caps)
+        out.update({
+            "gateway": True,
+            "replicas": {r.id: r.snapshot()
+                         for r in self._replicas.values()},
+            "models": sorted(models),
+            "pid": os.getpid(),
+        })
+        return out
+
+    def _cmd_stats(self, msg):
+        return {
+            "gateway": True,
+            "replicas": {r.id: r.snapshot()
+                         for r in self._replicas.values()},
+            "leases": len(self._leases),
+            "routes_inflight": len(self._routes),
+            "counters": self.counters.snapshot(),
+            "pid": os.getpid(),
+        }
+
+    def _cmd_telemetry(self, msg):
+        """The gateway's OWN telemetry in the TelemetryHub merge shape
+        (``ServeClient.register_with_hub`` against a gateway address
+        scrapes the routing tier, not a replica)."""
+        return {
+            "gateway": True,
+            "pid": os.getpid(),
+            "counters": self.counters.snapshot(),
+            "stages": self.timer.snapshot_serialized(),
+            "replicas": {r.id: r.snapshot()
+                         for r in self._replicas.values()},
+        }
+
+    def _cmd_drain(self, msg):
+        return self._drain_cmd(msg, True)
+
+    def _cmd_undrain(self, msg):
+        return self._drain_cmd(msg, False)
+
+    def _drain_cmd(self, msg, draining):
+        rid = msg.get("replica")
+        if rid not in self._replicas:
+            return {"error": (
+                f"unknown replica {rid!r}; known: {self._order}"
+            )}
+        (self.drain if draining else self.undrain)(rid)
+        return {"draining": [r.id for r in self._replicas.values()
+                             if r.draining]}
+
+    # -- routing -------------------------------------------------------------
+
+    def _route_fresh(self, model):
+        """Pick the replica a fresh episode goes to: healthy, not
+        draining, hosting ``model``; lowest load score, with ties going
+        to the ROTATION candidate (eligible replicas are ranked in
+        rotation order and ``min`` keeps the first on equal scores), so
+        equal-load fleets round-robin instead of pinning to the
+        lowest-sorting replica id."""
+        n = len(self._order)
+        eligible = []  # in rotation order starting at the pointer
+        for k in range(n):
+            r = self._replicas[self._order[(self._rr + k) % n]]
+            if r.healthy and not r.draining and r.hosts(model):
+                eligible.append(r)
+        if not eligible:
+            return None
+        self._rr = (self._rr + 1) % n
+        cand = eligible[0]
+        chosen = min(eligible, key=lambda r: r.load_score())
+        if chosen is not cand:
+            self.counters.incr("gateway_rebalances")
+        return chosen
+
+    def _forward(self, rep, ident, msg, cmd, model, gw_ep):
+        """Record the route and relay the request (BTMID verbatim).
+        The send is NON-blocking: a replica whose pipe is full (stalled
+        process, dead peer past the HWM) must cost its own clients an
+        actionable error, never freeze the whole gateway loop."""
+        import zmq
+
+        mid = msg.get(wire.BTMID_KEY)
+        span_ctx = msg.get(wire.SPAN_KEY)
+        trace = (span_ctx or {}).get("trace") \
+            if isinstance(span_ctx, dict) else None
+        prior = self._routes.get(mid) if mid is not None else None
+        if mid is not None:
+            self._routes[mid] = _Route(ident, rep.id, rep.incarnation,
+                                       cmd, model, gw_ep, trace,
+                                       now_us())
+            while len(self._routes) > ROUTE_CACHE_DEPTH:
+                self._routes.popitem(last=False)
+        t0 = time.perf_counter()
+        try:
+            wire.send_message_dealer(rep.sock, msg, raw_buffers=True,
+                                     flags=zmq.DONTWAIT)
+        except zmq.Again:
+            # pipe to the replica is full: it is stalled or gone.  If
+            # this was a RE-forward of an in-flight retry, the original
+            # send was already delivered and still owes a reply —
+            # restore that route and stay silent (an error here would
+            # be cached against a request the replica may yet apply).
+            # A FIRST forward is answered now, actionably (retriable),
+            # instead of parking in a queue that may never drain.
+            if prior is not None:
+                self._routes[mid] = prior
+                prior.ident = ident
+                return
+            if mid is not None:
+                self._routes.pop(mid, None)
+            self._local_reply(ident, msg, {"error": (
+                f"replica {rep.id} send queue full (stalled or "
+                "unreachable): retry, or reset() after its respawn"
+            )}, span_name=f"gateway:{cmd}", cache=False)
+            return
+        except zmq.ZMQError:
+            if mid is not None:
+                if prior is not None:
+                    self._routes[mid] = prior
+                    prior.ident = ident
+                else:
+                    self._routes.pop(mid, None)
+            return
+        self.timer.add("gw_forward", time.perf_counter() - t0)
+        self.counters.incr("gateway_routed")
+
+    def _local_reply(self, ident, msg, reply, *, span_name, cache=True):
+        """Answer a request from the gateway itself (control commands,
+        stale-lease errors, cache hits): stamp mid + span, cache
+        mutating replies so retries stay local, send.
+
+        ``cache=False`` for TRANSIENT transport/routing errors ("no
+        healthy replica", "send queue full"): those are not processing
+        outcomes, and caching them would answer a same-mid retry with
+        the stale error after the fleet has already healed — the
+        advertised remediation would be unreachable for that RPC."""
+        mid = msg.get(wire.BTMID_KEY)
+        if "error" in reply:
+            self.counters.incr("gateway_errors")
+        span_ctx = msg.get(wire.SPAN_KEY)
+        if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
+            reply = dict(reply)
+            reply[wire.SPANS_KEY] = [make_span(
+                span_name, now_us(), trace=span_ctx["trace"],
+                cat="gateway",
+            )]
+        if mid is not None:
+            reply[wire.BTMID_KEY] = mid
+            if cache and msg.get("cmd") in MUTATING_CMDS:
+                self._cache_reply(mid, reply)
+        self._send_client(ident, reply)
+
+    def _cache_reply(self, mid, reply):
+        self._reply_cache[mid] = reply
+        while len(self._reply_cache) > self._reply_cache_depth:
+            self._reply_cache.popitem(last=False)
+
+    def _send_client(self, ident, reply):
+        import zmq
+
+        try:
+            wire.send_message_router(self._front, ident, reply,
+                                     raw_buffers=True)
+            self.counters.incr("gateway_replies")
+        except zmq.ZMQError:
+            pass  # client gone; its retry will re-dial
+
+    def _handle_client(self, ident, msg):
+        t_route = time.perf_counter()
+        self.counters.incr("gateway_requests")
+        mid = msg.get(wire.BTMID_KEY)
+        cmd = msg.get("cmd")
+        if mid is not None and cmd in MUTATING_CMDS \
+                and mid in self._reply_cache:
+            # retry of a request whose reply the client lost: answered
+            # from the gateway cache — the fleet never sees it again
+            self.counters.incr("gateway_cache_hits")
+            self._send_client(ident, self._reply_cache[mid])
+            return
+        if mid is not None and mid in self._routes:
+            # retry of an IN-FLIGHT forward: re-point the client route
+            # and re-send to the SAME replica, whose own dedupe/reply
+            # cache keeps the retry exactly-once end-to-end.  A retried
+            # step/close carries the GATEWAY lease id again, so it is
+            # rewritten through the lease exactly like a first send.
+            route = self._routes[mid]
+            route.ident = ident
+            rep = self._replicas.get(route.rid)
+            lease = (self._leases.get(route.gw_ep)
+                     if route.gw_ep is not None else None)
+            rewritable = route.cmd == "reset" or (
+                lease is not None and not lease.dead
+            )
+            if rep is not None and rep.healthy and rewritable:
+                if lease is not None:
+                    msg["slot"] = lease.slot
+                    msg["episode"] = lease.episode
+                self.counters.incr("gateway_dup_inflight")
+                self._forward(rep, ident, msg, route.cmd, route.model,
+                              route.gw_ep)
+                return
+            # the replica died holding the request (or the lease did):
+            # drop the route and fall through to fresh handling (a
+            # reset re-routes; a step's dead lease errors actionably)
+            del self._routes[mid]
+        if cmd in GATEWAY_CMDS:
+            handler = getattr(self, f"_cmd_{cmd}")
+            try:
+                reply = handler(msg)
+            except Exception as exc:  # noqa: BLE001 - surfaced to client
+                logger.exception("gateway: %r failed", cmd)
+                reply = {"error": f"{type(exc).__name__}: {exc}"}
+            if cmd == "hello" and mid is not None \
+                    and "obs_dim" not in reply:
+                # startup window: no scrape has delivered capability
+                # fields yet — forward THIS hello to a healthy replica
+                # (the reply path stashes its caps and overlays the
+                # gateway fields), so PR-10 hello consumers never see a
+                # capability-less reply while the fleet is up
+                rep = next((r for r in self._replicas.values()
+                            if r.healthy), None)
+                if rep is not None:
+                    self.timer.add("gw_route",
+                                   time.perf_counter() - t_route)
+                    self._forward(rep, ident, msg, "hello", None, None)
+                    return
+            self.timer.add("gw_route", time.perf_counter() - t_route)
+            self._local_reply(ident, msg, reply,
+                              span_name=f"gateway:{cmd}")
+            return
+        if mid is None:
+            # forwarded replies route back to clients BY correlation id
+            # (the replica's reply carries no client identity): a
+            # mid-less request would execute on the replica with its
+            # reply unroutable — reject it here, actionably, instead
+            self.timer.add("gw_route", time.perf_counter() - t_route)
+            self._local_reply(ident, msg, {"error": (
+                f"{cmd!r} through a gateway needs a correlation id "
+                "(wire.stamp_message_id); its reply could not be "
+                "routed back otherwise"
+            )}, span_name=f"gateway:{cmd}")
+            return
+        if cmd == "reset":
+            model = msg.get("model")
+            rep = self._route_fresh(model)
+            self.timer.add("gw_route", time.perf_counter() - t_route)
+            if rep is None:
+                self._local_reply(ident, msg, {"error": (
+                    "no healthy replica"
+                    + (f" hosting model {model!r}" if model else "")
+                    + f" (fleet: {self._order}); retry after respawn"
+                )}, span_name="gateway:reset", cache=False)
+                return
+            rep.pending_live += 1
+            self._forward(rep, ident, msg, "reset", model, None)
+            return
+        if cmd in ("step", "close"):
+            gw_ep = msg.get("episode")
+            lease = self._leases.get(gw_ep)
+            if lease is None or lease.dead:
+                self.timer.add("gw_route",
+                               time.perf_counter() - t_route)
+                if lease is not None:
+                    self._drop_lease(gw_ep)
+                self.counters.incr("gateway_stale_lease_redirects")
+                if cmd == "close":
+                    # mirror the server's stale-close semantics: a
+                    # no-op close is answered, never an error
+                    self._local_reply(ident, msg, {"closed": False},
+                                      span_name="gateway:close")
+                    return
+                dead_on = (f" (replica {lease.rid} died)"
+                           if lease is not None else "")
+                self._local_reply(ident, msg, {
+                    "error": (
+                        f"stale episode lease {gw_ep!r}{dead_on}: "
+                        "reset() and resume on a healthy replica"
+                    ),
+                    "lease": "stale" if lease is not None else "unknown",
+                }, span_name=f"gateway:{cmd}")
+                return
+            rep = self._replicas[lease.rid]
+            # rewrite to the replica's REAL lease; everything else —
+            # mid, span context, obs buffers — rides verbatim
+            msg["slot"] = lease.slot
+            msg["episode"] = lease.episode
+            lease.t_use = time.monotonic()
+            self.counters.incr("gateway_affinity_hits")
+            self.timer.add("gw_route", time.perf_counter() - t_route)
+            self._forward(rep, ident, msg, cmd, lease.model, gw_ep)
+            return
+        self.timer.add("gw_route", time.perf_counter() - t_route)
+        self._local_reply(ident, msg, {
+            "error": f"unknown serve command {cmd!r}"
+        }, span_name="gateway:unknown")
+
+    # -- reply path ----------------------------------------------------------
+
+    def _handle_replica_reply(self, rep, reply):
+        t0 = time.perf_counter()
+        # ANY reply on this socket proves the process is alive: a
+        # replica busy in a long compile must not get quarantined for
+        # missing a scrape while it is actively answering traffic
+        # (re-admission itself stays scrape-driven)
+        rep.last_ok = time.monotonic()
+        mid = reply.get(wire.BTMID_KEY)
+        if mid is not None and mid in self._scrapes:
+            rid = self._scrapes.pop(mid)
+            self._ingest_scrape(self._replicas[rid], reply)
+            return
+        route = self._routes.get(mid) if mid is not None else None
+        if route is None:
+            # a dup (cache hit + original), or a client that gave up
+            self.counters.incr("stale_replies")
+            return
+        if route.rid != rep.id:
+            # late reply from a replica this request was re-routed
+            # AWAY from (quarantine mid-retry): the live route belongs
+            # to the new replica — leave it for the genuine reply
+            self.counters.incr("stale_replies")
+            return
+        del self._routes[mid]
+        reply["replica"] = rep.id
+        if "error" in reply:
+            # name the replica in the traceback the client will raise
+            reply["error"] = f"replica {rep.id}: {reply['error']}"
+            if reply.get("lease") in ("unknown", "stale") \
+                    and route.gw_ep is not None:
+                # the replica disowned the lease (evicted/restarted):
+                # forget it so the next step short-circuits here.  This
+                # is the SAME client-visible event as the gateway's own
+                # dead-lease redirect (which side answers first is a
+                # race between watchdog respawn and client retry), so
+                # it counts under the same name
+                self._drop_lease(route.gw_ep)
+                self.counters.incr("gateway_stale_lease_redirects")
+        elif route.cmd == "reset":
+            if not rep.healthy or route.inc != rep.incarnation:
+                # a reset reply drained AFTER the replica was
+                # quarantined — or from an incarnation older than the
+                # current one (a silent restart was detected between
+                # forward and reply): registering a live lease here
+                # would point the client's steps at a dead slot — and
+                # poison _lease_rev for the new incarnation's recycled
+                # episode ids.  Drop it; the client's retry re-routes
+                # the reset to a healthy replica.
+                self.counters.incr("stale_replies")
+                return
+            real_ep = reply.get("episode")
+            key = (rep.id, rep.incarnation, real_ep)
+            gw_ep = self._lease_rev.get(key)
+            if gw_ep is None:
+                self._ep_seq += 1
+                gw_ep = self._ep_seq
+                self._leases[gw_ep] = _Lease(
+                    rep.id, reply.get("slot"), real_ep, route.model,
+                    rep.incarnation,
+                )
+                self._lease_rev[key] = gw_ep
+            reply["episode"] = gw_ep
+        elif route.cmd == "close":
+            self._drop_lease(route.gw_ep)
+        elif route.cmd == "hello":
+            # a forwarded startup hello: stash the replica's capability
+            # fields for every later gateway-local hello, and overlay
+            # the gateway's own fields on THIS reply
+            rep.caps = {
+                k: reply[k]
+                for k in ("model", "obs_dim", "slots", "serial", "int8",
+                          "max_batch", "buckets")
+                if k in reply
+            }
+            reply.update(self._cmd_hello({}))
+        if route.span_trace is not None:
+            spans = reply.setdefault(wire.SPANS_KEY, [])
+            spans.append(make_span(
+                f"gateway:{route.cmd}", route.t0_us,
+                trace=route.span_trace, cat="gateway",
+            ))
+        if mid is not None and route.cmd in MUTATING_CMDS:
+            self._cache_reply(mid, reply)
+        self._send_client(route.ident, reply)
+        self.timer.add("gw_reply", time.perf_counter() - t0)
+
+    # -- serving -------------------------------------------------------------
+
+    def _drain_front(self):
+        import zmq
+
+        drain_socket(
+            lambda: wire.recv_message_router(self._front,
+                                             flags=zmq.NOBLOCK),
+            lambda out: self._handle_client(*out),
+            self.counters, "gateway", "client request",
+        )
+
+    def _drain_replica(self, rep):
+        import zmq
+
+        drain_socket(
+            lambda: wire.recv_message_dealer(rep.sock,
+                                             flags=zmq.NOBLOCK),
+            lambda reply: self._handle_replica_reply(rep, reply),
+            self.counters, "gateway", "replica reply",
+        )
+
+    def serve_forever(self, stop_event=None, poll_ms=50):
+        import zmq
+
+        poller = zmq.Poller()
+        poller.register(self._front, zmq.POLLIN)
+        for rep in self._replicas.values():
+            poller.register(rep.sock, zmq.POLLIN)
+        while stop_event is None or not stop_event.is_set():
+            self._apply_notices()
+            self._scrape_tick()
+            try:
+                events = dict(poller.poll(poll_ms))
+                if self._front in events:
+                    self._drain_front()
+                for rep in self._replicas.values():
+                    if rep.sock in events:
+                        self._drain_replica(rep)
+            except zmq.ZMQError:
+                return  # a socket closed under us: clean shutdown
+
+    def close(self):
+        try:
+            self._front.close(0)
+        except Exception:  # noqa: BLE001 - shutdown best-effort
+            pass
+        for rep in self._replicas.values():
+            try:
+                rep.sock.close(0)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class _LocalGatewayHandle:
+    """An in-process gateway (thread) for tests and benchmarks."""
+
+    def __init__(self, gateway, thread, stop):
+        self.gateway = gateway
+        self.address = gateway.address
+        self._thread = thread
+        self._stop = stop
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.gateway.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_gateway_thread(replicas, *, address="tcp://127.0.0.1:*",
+                         counters=None, timer=None, **kwargs):
+    """Serve a :class:`ServeGateway` from a daemon thread; returns a
+    handle with ``.address``, ``.gateway`` and ``.close()``."""
+    gateway = ServeGateway(address, replicas, counters=counters,
+                           timer=timer, **kwargs)
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=gateway.serve_forever, kwargs={"stop_event": stop},
+        daemon=True, name="bjx-serve-gateway",
+    )
+    thread.start()
+    return _LocalGatewayHandle(gateway, thread, stop)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Route a fleet of blendjax policy servers."
+    )
+    ap.add_argument("--address", required=True)
+    ap.add_argument("--replica", action="append", required=True,
+                    help="backend replica address (repeatable)")
+    ap.add_argument("--scrape-interval", type=float, default=0.25)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    gateway = ServeGateway(args.address, args.replica,
+                           scrape_interval_s=args.scrape_interval)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    logger.info("serve gateway at %s over %d replicas",
+                gateway.address, len(args.replica))
+    try:
+        gateway.serve_forever(stop_event=stop)
+    finally:
+        gateway.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,44 @@ STATELESS_TTL_S = 30.0
 REPLY_CACHE_DEPTH = 1024
 
 
+def drain_socket(recv, handle, counters, who, what):
+    """Drain every message currently on a socket: ``recv()`` (NOBLOCK)
+    until ``zmq.Again``, dispatching each to ``handle``.  One copy of
+    the survival discipline the serve tier's three receive loops share
+    (server front, gateway front, gateway replica backends): a closed
+    socket propagates (the serve loop shuts down cleanly), an
+    UNDECODABLE frame (garbling proxy, rogue peer) is dropped and
+    counted — never fatal; the frames are consumed and the sender's
+    retry re-sends intact bytes.  The same contract covers ``handle``:
+    a malformed-but-decodable message (e.g. an unhashable correlation
+    id — the wire is pickle, a rogue peer can send anything) must cost
+    that message, not the serving thread."""
+    import zmq
+
+    while True:
+        try:
+            out = recv()
+        except zmq.Again:
+            return
+        except zmq.ZMQError:
+            raise  # socket closed: the outer loop shuts down
+        except Exception as exc:  # noqa: BLE001 - the tier survives
+            counters.incr("serve_errors")
+            logger.warning(
+                "%s: undecodable %s dropped (%s: %s)",
+                who, what, type(exc).__name__, exc,
+            )
+            continue
+        try:
+            handle(out)
+        except zmq.ZMQError:
+            raise  # socket closed mid-handle: clean shutdown
+        except Exception:  # noqa: BLE001 - the tier survives
+            counters.incr("serve_errors")
+            logger.exception("%s: handling a %s failed (dropped)",
+                             who, what)
+
+
 def default_buckets(max_batch):
     """Powers of two up to ``max_batch`` (inclusive as the cap): each
     bucket is one XLA compilation, so requests pad to the next bucket
@@ -118,14 +156,23 @@ class LinearModel:
     (a double-applied step shifts every later prediction, so
     exactly-once violations are *visible*), and import-cheap — the
     chaos tests SIGKILL/respawn servers of this model in well under a
-    second."""
+    second.
+
+    ``work_us`` adds a sleep-based per-ROW model-compute stand-in to
+    ``step_rows`` (the same disclosed pattern as the RL bench's
+    ``physics_us``): the gateway scale-out bench needs replicas whose
+    per-request cost is real enough to be the bottleneck, without
+    spinning CPU the 2-core CI box does not have.  Zero (the default)
+    is byte-identical to the pre-knob model."""
 
     kind = "linear"
 
-    def __init__(self, obs_dim=8, out_dim=None, slots=16, seed=0):
+    def __init__(self, obs_dim=8, out_dim=None, slots=16, seed=0,
+                 work_us=0):
         self.obs_dim = int(obs_dim)
         self.out_dim = int(out_dim or obs_dim)
         self.slots = int(slots)
+        self.work_us = float(work_us)
         rng = np.random.default_rng(seed)
         self.w = rng.standard_normal(
             (self.obs_dim, self.out_dim)
@@ -138,10 +185,23 @@ class LinearModel:
         self.pos[idx] = 0
 
     def step_rows(self, idx, obs):
+        if self.work_us:
+            # per-row cost: batching does not amortize model compute
+            # away (a batched decode's FLOPs scale with occupancy)
+            time.sleep(len(idx) * self.work_us / 1e6)
         pred = obs.astype(np.float32) @ self.w \
             + self.pos[idx, None].astype(np.float32)
         self.pos[idx] += 1
         return pred
+
+    def prefill_rows(self, idx, prefix):
+        """Admit a T-step prefix in one pass: the slot's position jumps
+        to T and the return is the prediction the T'th serial step would
+        have produced — the jax-free analogue of the seqformer's batched
+        prefill, so gateway/prefill plumbing tests run without jax."""
+        t = prefix.shape[0]
+        self.pos[idx] = t
+        return prefix[-1].astype(np.float32) @ self.w + np.float32(t - 1)
 
 
 class PolicyModel:
@@ -240,6 +300,73 @@ class SeqFormerModel:
         # tradeoff the admission queue pads for
         self._step = jax.jit(_step)
 
+        def _prefill(params, cache, row, prefix):
+            # ONE teacher-forced pass fills the slot's KV rows (the
+            # standard prefill/decode split, exactly rollout()'s
+            # prefill phase) instead of T serial decode_steps.  k/v
+            # are rotated before the sink, so the cache holds the same
+            # bytes serial decode would have written; positions past
+            # the ring keep only the tail that fits, placed at each
+            # position's ring slot.
+            from blendjax.parallel.ring_attention import full_attention
+
+            kvs = []
+            preds, _ = seqformer._forward(
+                params, prefix[None],
+                lambda q, k, v: full_attention(
+                    q, k, v, causal=True, window=window
+                ),
+                cdt, "dense", 2, 1.25, kv_sink=kvs,
+            )
+            t0 = prefix.shape[0]
+            ring = cache["k"][0].shape[1]
+            keep_n = min(t0, ring)
+            slots_ax = (jnp.arange(keep_n) + (t0 - keep_n)) % ring
+            new = {"pos": cache["pos"].at[row].set(t0), "k": [], "v": []}
+            for i, (k, v) in enumerate(kvs):
+                new["k"].append(cache["k"][i].at[row[0], slots_ax].set(
+                    k[0, t0 - keep_n:].astype(cache["k"][i].dtype)
+                ))
+                new["v"].append(cache["v"][i].at[row[0], slots_ax].set(
+                    v[0, t0 - keep_n:].astype(cache["v"][i].dtype)
+                ))
+            return preds[0, -1], new
+
+        # one compilation per prefix LENGTH (prefix rows are real
+        # observations — padding them would write fabricated positions
+        # into the cache, so lengths are not bucketed)
+        self._prefill = jax.jit(_prefill)
+
+    def prefill_rows(self, idx, prefix):
+        """Admit a T-step observation prefix into slot ``idx`` with one
+        teacher-forced batched pass (vs T serial ``decode_step``s —
+        parity within 1e-5, tests/test_serve.py).  Returns the
+        prediction for position T (what the T'th serial step would have
+        returned); the slot's next ``step`` decodes at position T."""
+        t0 = int(prefix.shape[0])
+        if t0 > self.length:
+            # the teacher-forced pass attends the WHOLE prefix; serial
+            # decode through a ring of `length` slots would only see
+            # the last `length` (or `window`) positions — refuse the
+            # configs where the two paths cannot agree
+            if self.window is None or self.window > self.length:
+                raise ValueError(
+                    f"prefix of {t0} steps exceeds the {self.length}-slot "
+                    "cache ring (and no window bounds attention): raise "
+                    "length= or serve a windowed model"
+                )
+        if "pos" in self.params and t0 > self.params["pos"].shape[0]:
+            raise ValueError(
+                f"prefix of {t0} steps exceeds the learned position "
+                f"table ({self.params['pos'].shape[0]}); use "
+                "pos_encoding='rope' for longer prefixes"
+            )
+        pred, self._cache = self._prefill(
+            self.params, self._cache, self._jnp.asarray(idx),
+            self._jnp.asarray(prefix),
+        )
+        return np.asarray(pred)
+
     def reset_rows(self, idx):
         # rewinding pos to 0 is sufficient: _attn_one masks by each
         # slot's absolute position, so the stale k/v rows of the slot's
@@ -262,15 +389,34 @@ class SeqFormerModel:
 
 
 class _Pending:
-    __slots__ = ("ident", "mid", "msg", "t_enq", "span_trace", "t0_us")
+    __slots__ = ("ident", "mid", "msg", "t_enq", "span_trace", "t0_us",
+                 "mstate")
 
-    def __init__(self, ident, mid, msg, span_trace, t0_us):
+    def __init__(self, ident, mid, msg, span_trace, t0_us, mstate):
         self.ident = ident
         self.mid = mid
         self.msg = msg
         self.t_enq = time.perf_counter()
         self.span_trace = span_trace
         self.t0_us = t0_us
+        self.mstate = mstate
+
+
+class _ModelState:
+    """One hosted model's serving state: its slot pool (or stateless
+    episode registry) — multi-model servers keep one per model id, so
+    one model's slot exhaustion can never deny another's resets."""
+
+    __slots__ = ("mid", "model", "free", "live", "stateless_eps")
+
+    def __init__(self, mid, model):
+        self.mid = mid
+        self.model = model
+        self.free = list(range(model.slots))
+        # slot -> [episode lease id, monotonic last-use]
+        self.live = {}
+        # stateless: episode id -> monotonic last-use
+        self.stateless_eps = {}
 
 
 class PolicyServer:
@@ -287,7 +433,15 @@ class PolicyServer:
         A served-model adapter (:class:`LinearModel`,
         :class:`PolicyModel`, :class:`SeqFormerModel`): ``kind``,
         ``obs_dim``, ``slots`` (0 = stateless), ``pad_slot``,
-        ``reset_rows(idx)``, ``step_rows(idx, obs)``.
+        ``reset_rows(idx)``, ``step_rows(idx, obs)`` (and optionally
+        ``prefill_rows(idx, prefix)``) — OR a ``{model_id: adapter}``
+        dict to host several models behind one socket (**multi-model
+        routing**): requests carry ``model`` in the envelope, each
+        model keeps its OWN slot pool and its own jitted bucket cache,
+        and a tick batches one model's requests (requests without a
+        ``model`` key go to the first/default model, so a single-model
+        workload against a multi-model server is byte-identical to a
+        single-model server — test-locked).
     serial: bool
         REP socket, batch size 1, no queue — the serial baseline.
     tick_ms: float
@@ -311,7 +465,17 @@ class PolicyServer:
                  timer=None, context=None):
         import zmq
 
-        self.model = model
+        if isinstance(model, dict):
+            if not model:
+                raise ValueError("multi-model server needs >= 1 model")
+            self._models = {
+                str(k): _ModelState(str(k), m) for k, m in model.items()
+            }
+        else:
+            # single adapter: hosted under its kind (what a multi-model
+            # dict hosting just this model would naturally be keyed by)
+            self._models = {model.kind: _ModelState(model.kind, model)}
+        self._default_id = next(iter(self._models))
         self.serial = bool(serial)
         self.tick_ms = float(tick_ms)
         self.buckets = tuple(sorted(
@@ -329,23 +493,23 @@ class PolicyServer:
         self._reply_cache_depth = int(reply_cache_depth)
         self._queue = deque()
         self._pending = {}  # mid -> _Pending still queued (dedupe)
-        self._free = list(range(model.slots))
-        # slot -> [episode lease id, monotonic last-use].  The lease id
-        # disambiguates slot REUSE: an evicted episode's client still
-        # holds the slot number, and without the lease its next step
-        # would silently advance the new tenant's cache row
-        self._live = {}
-        self._episode_seq = 0
-        # stateless models have no slot pool, but the admission window
+        # Slot pools live per hosted model (:class:`_ModelState`):
+        # ``live`` maps slot -> [episode lease id, monotonic last-use].
+        # The lease id disambiguates slot REUSE: an evicted episode's
+        # client still holds the slot number, and without the lease its
+        # next step would silently advance the new tenant's cache row.
+        # Stateless models have no slot pool, but the admission window
         # still needs a live-episode count for its early exit (a
         # blocking client keeps one step in flight, so waiting past
-        # that count is pure latency): episode id -> last monotonic
-        # use, touched by reset AND step (so a client that resumed
-        # past a server restart re-registers), pruned after
-        # STATELESS_TTL_S idle (a crashed client must not inflate the
-        # window target forever — state*ful* slots decay via
-        # slot_ttl_s eviction, this is the stateless analogue)
-        self._stateless_eps = {}
+        # that count is pure latency): ``stateless_eps`` maps episode
+        # id -> last monotonic use, touched by reset AND step (so a
+        # client that resumed past a server restart re-registers),
+        # pruned after STATELESS_TTL_S idle (a crashed client must not
+        # inflate the window target forever — state*ful* slots decay
+        # via slot_ttl_s eviction, this is the stateless analogue).
+        # The episode-lease sequence is server-GLOBAL, so no two hosted
+        # models can ever hand out the same lease id.
+        self._episode_seq = 0
         self._ctx = context or zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.REP if self.serial
                                       else zmq.ROUTER)
@@ -358,74 +522,168 @@ class PolicyServer:
             self._sock.bind(address)
             self.address = address
 
+    @property
+    def model(self):
+        """The default hosted model's adapter (the single model for
+        single-model servers) — the pre-multi-model surface tests and
+        benches poke at."""
+        return self._models[self._default_id].model
+
+    @property
+    def models(self):
+        """Hosted model ids, default first."""
+        return tuple(self._models)
+
+    def _state_or_error(self, msg):
+        """Resolve the request's model state; returns ``(state, None)``
+        or ``(None, error reply)`` for an unknown model id."""
+        mid = msg.get("model")
+        st = self._models.get(self._default_id if mid is None else mid)
+        if st is None:
+            return None, {"error": (
+                f"unknown model {mid!r}; hosted: {sorted(self._models)}"
+            )}
+        return st, None
+
     # -- slot pool -----------------------------------------------------------
 
-    def _alloc_slot(self):
+    def _alloc_slot(self, st):
         """Returns (slot, episode lease id) or (None, None) when full."""
-        if self.model.slots == 0:
+        if st.model.slots == 0:
             self._episode_seq += 1
-            self._stateless_eps[self._episode_seq] = time.monotonic()
+            st.stateless_eps[self._episode_seq] = time.monotonic()
             return -1, self._episode_seq
-        if not self._free and self.slot_ttl_s is not None:
+        if not st.free and self.slot_ttl_s is not None:
             now = time.monotonic()
-            stale = [s for s, (_, ts) in self._live.items()
+            stale = [s for s, (_, ts) in st.live.items()
                      if now - ts > self.slot_ttl_s]
             for s in stale:
-                del self._live[s]
-                self._free.append(s)
+                del st.live[s]
+                st.free.append(s)
             if stale:
                 self.counters.incr("serve_evictions", len(stale))
-        if not self._free:
+        if not st.free:
             return None, None
-        slot = self._free.pop()
+        slot = st.free.pop()
         self._episode_seq += 1
-        self._live[slot] = [self._episode_seq, time.monotonic()]
-        self.model.reset_rows(np.asarray([slot]))
+        st.live[slot] = [self._episode_seq, time.monotonic()]
+        st.model.reset_rows(np.asarray([slot]))
         return slot, self._episode_seq
 
-    def _free_slot(self, slot, episode=None):
-        lease = self._live.get(slot)
+    def _free_slot(self, st, slot, episode=None):
+        lease = st.live.get(slot)
         if lease is None:
             return False
         if episode is not None and lease[0] != episode:
             return False  # a stale close must not kill the new tenant
-        del self._live[slot]
-        self._free.append(slot)
+        del st.live[slot]
+        st.free.append(slot)
         return True
 
     # -- request handling ----------------------------------------------------
 
+    def _live_episodes(self):
+        """Live episodes across every hosted model (window targeting,
+        stats, the gateway's load scrape)."""
+        return sum(
+            len(st.live) if st.model.slots > 0 else len(st.stateless_eps)
+            for st in self._models.values()
+        )
+
     def _cmd_hello(self, msg):
+        st = self._models[self._default_id]
         return {
-            "model": self.model.kind,
-            "obs_dim": self.model.obs_dim,
-            "slots": self.model.slots,
-            "free_slots": len(self._free),
+            "model": st.model.kind,
+            "obs_dim": st.model.obs_dim,
+            "slots": st.model.slots,
+            "free_slots": len(st.free),
             "serial": self.serial,
-            "int8": bool(getattr(self.model, "int8", False)),
+            "int8": bool(getattr(st.model, "int8", False)),
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
+            "models": {
+                s.mid: {
+                    "kind": s.model.kind,
+                    "obs_dim": s.model.obs_dim,
+                    "slots": s.model.slots,
+                    "free_slots": len(s.free),
+                    "int8": bool(getattr(s.model, "int8", False)),
+                }
+                for s in self._models.values()
+            },
             "pid": os.getpid(),
         }
 
     def _cmd_reset(self, msg):
-        slot, episode = self._alloc_slot()
+        st, err = self._state_or_error(msg)
+        if err is not None:
+            return err
+        slot, episode = self._alloc_slot(st)
         if slot is None:
             self.counters.incr("serve_slot_denied")
             return {"error": (
-                f"no free episode slot ({self.model.slots} live); close "
-                "an episode or raise slots="
+                f"no free episode slot ({st.model.slots} live on model "
+                f"{st.mid!r}); close an episode or raise slots="
             )}
+        reply = {"slot": slot, "episode": episode}
+        prefix = msg.get("prefix")
+        if prefix is not None:
+            err = self._prefill(st, slot, episode, prefix, reply)
+            if err is not None:
+                return err
         self.counters.incr("serve_resets")
-        return {"slot": slot, "episode": episode}
+        return reply
+
+    def _prefill(self, st, slot, episode, prefix, reply):
+        """Batched prefill admission: replay a T-step observation
+        prefix into the freshly-allocated slot with ONE teacher-forced
+        pass (``model.prefill_rows``) instead of T serial decode steps.
+        Mutates ``reply`` in place on success; returns an error reply
+        (with the slot freed again) on failure."""
+        def fail(text):
+            if st.model.slots > 0:
+                self._free_slot(st, slot, episode)
+            else:
+                st.stateless_eps.pop(episode, None)
+            return {"error": text}
+
+        if not hasattr(st.model, "prefill_rows") or st.model.slots == 0:
+            return fail(
+                f"model {st.mid!r} ({st.model.kind}) is stateless or "
+                "has no prefill path: admit without a prefix"
+            )
+        try:
+            prefix = np.asarray(prefix, np.float32)
+        except (TypeError, ValueError) as exc:
+            return fail(f"prefix not coercible to float32: {exc}")
+        if prefix.ndim != 2 or prefix.shape[0] < 1 \
+                or prefix.shape[1] != st.model.obs_dim:
+            return fail(
+                f"prefix shape {prefix.shape} != (T >= 1, "
+                f"{st.model.obs_dim})"
+            )
+        try:
+            pred = st.model.prefill_rows(np.asarray([slot]), prefix)
+        except Exception as exc:  # noqa: BLE001 - surfaced to client
+            logger.exception("policy server: prefill failed")
+            return fail(f"prefill failed: {type(exc).__name__}: {exc}")
+        self.counters.incr("serve_prefills")
+        # the prediction for position T (what the T'th serial step
+        # would have returned) and the position the next step consumes
+        reply["pred"] = np.ascontiguousarray(pred)
+        reply["pos"] = int(prefix.shape[0])
+        return None
 
     def _cmd_close(self, msg):
-        if self.model.slots == 0:
-            closed = self._stateless_eps.pop(
+        st, err = self._state_or_error(msg)
+        if err is not None:
+            return err
+        if st.model.slots == 0:
+            closed = st.stateless_eps.pop(
                 msg.get("episode"), None
             ) is not None
         else:
-            closed = self._free_slot(int(msg.get("slot", -1)),
+            closed = self._free_slot(st, int(msg.get("slot", -1)),
                                      msg.get("episode"))
         if closed:
             # a no-op close (unknown slot, stale/pruned lease, a
@@ -435,17 +693,36 @@ class PolicyServer:
         return {"closed": closed}
 
     def _cmd_stats(self, msg):
+        # top-level slot fields describe the DEFAULT model (the whole
+        # server for single-model hosting, where slots/free/live stay
+        # mutually consistent); per-model occupancy lives under
+        # ``per_model`` so multi-model capacity math has coherent
+        # numbers instead of a cross-model mix
+        st = self._models[self._default_id]
         return {
-            "model": self.model.kind,
-            "slots": self.model.slots,
-            "live_slots": len(self._live),
+            "model": st.model.kind,
+            "slots": st.model.slots,
+            "live_slots": len(st.live),
             "live_episodes": (
-                len(self._live) if self.model.slots > 0
-                else len(self._stateless_eps)
+                len(st.live) if st.model.slots > 0
+                else len(st.stateless_eps)
             ),
-            "free_slots": len(self._free),
+            "free_slots": len(st.free),
             "queued": len(self._queue),
             "serial": self.serial,
+            "models": list(self._models),
+            "per_model": {
+                s.mid: {
+                    "slots": s.model.slots,
+                    "free_slots": len(s.free),
+                    "live_slots": len(s.live),
+                    "live_episodes": (
+                        len(s.live) if s.model.slots > 0
+                        else len(s.stateless_eps)
+                    ),
+                }
+                for s in self._models.values()
+            },
             "counters": self.counters.snapshot(),
             "pid": os.getpid(),
         }
@@ -454,9 +731,27 @@ class PolicyServer:
         """This process's telemetry in the TelemetryHub merge shape —
         the PULL half of remote scraping (a consumer-side hub registers
         ``lambda: client.telemetry()`` and this server needs no
-        exporter, no extra socket)."""
+        exporter, no extra socket).  ``queued``/``live_episodes``/
+        ``models``/``hello`` ride along for the gateway's cached load
+        scrape — one RPC covers liveness, load, capability AND
+        telemetry (the gateway's own ``hello`` reply merges the
+        capability fields so PR-10 hello consumers work unchanged
+        against a gateway address)."""
+        st = self._models[self._default_id]
         return {
-            "model": self.model.kind,
+            "model": st.model.kind,
+            "models": list(self._models),
+            "queued": len(self._queue),
+            "live_episodes": self._live_episodes(),
+            "hello": {
+                "model": st.model.kind,
+                "obs_dim": st.model.obs_dim,
+                "slots": st.model.slots,
+                "serial": self.serial,
+                "int8": bool(getattr(st.model, "int8", False)),
+                "max_batch": self.max_batch,
+                "buckets": list(self.buckets),
+            },
             "pid": os.getpid(),
             "counters": self.counters.snapshot(),
             "stages": self.timer.snapshot_serialized(),
@@ -532,29 +827,53 @@ class PolicyServer:
             self.counters.incr("serve_dup_inflight")
             self._pending[mid].ident = ident
             return
+        st, err = self._state_or_error(msg)
+        if err is not None:
+            self.counters.incr("serve_errors")
+            self._finish(ident, msg, err, span_name="serve:step",
+                         t0_us=t0_us)
+            return
         span_ctx = msg.get(wire.SPAN_KEY)
         trace = (span_ctx or {}).get("trace") \
             if isinstance(span_ctx, dict) else None
-        ent = _Pending(ident, mid, msg, trace, t0_us)
+        ent = _Pending(ident, mid, msg, trace, t0_us, st)
         self._queue.append(ent)
         if mid is not None:
             self._pending[mid] = ent
 
-    def _step_entry_error(self, ent, text):
+    def _step_entry_error(self, ent, text, lease=None):
+        """Error-reply one queued step.  ``lease`` ("unknown"/"stale")
+        rides as a structured field so a gateway can drop its own lease
+        entry without parsing error prose."""
         self.counters.incr("serve_errors")
-        self._finish(ent.ident, ent.msg, {"error": text},
+        reply = {"error": text}
+        if lease is not None:
+            reply["lease"] = lease
+        self._finish(ent.ident, ent.msg, reply,
                      span_name="serve:step", t0_us=ent.t0_us)
 
     def _tick(self):
         """Drain up to ``max_batch`` queued steps into one padded,
-        bucketed model call and scatter the replies."""
+        bucketed model call and scatter the replies.  A tick serves ONE
+        hosted model (the queue head's); entries for other models are
+        left in order and the return value says so, so the serve loop
+        ticks again immediately instead of making them wait out another
+        admission window."""
         t_assemble = time.perf_counter()
-        stateful = self.model.slots > 0
+        head = None
+        skipped = deque()
         batch = []
         while self._queue and len(batch) < self.max_batch:
             ent = self._queue.popleft()
+            if head is None:
+                head = ent.mstate
+            elif ent.mstate is not head:
+                skipped.append(ent)
+                continue
             if ent.mid is not None:
                 self._pending.pop(ent.mid, None)
+            st = ent.mstate
+            stateful = st.model.slots > 0
             slot = int(ent.msg.get("slot", -1)) if stateful else -1
             if not stateful:
                 ep = ent.msg.get("episode")
@@ -562,14 +881,14 @@ class PolicyServer:
                     # touch (or re-register, after a server restart)
                     # the episode's liveness for window targeting —
                     # stateless steps are never refused
-                    self._stateless_eps[ep] = time.monotonic()
+                    st.stateless_eps[ep] = time.monotonic()
             if stateful:
-                lease = self._live.get(slot)
+                lease = st.live.get(slot)
                 if lease is None:
                     self._step_entry_error(ent, (
                         f"unknown episode slot {slot} (closed, evicted, "
                         "or a restarted server): reset() and resume"
-                    ))
+                    ), lease="unknown")
                     continue
                 if ent.msg.get("episode") not in (None, lease[0]):
                     # slot number reused by a NEW episode: the stale
@@ -577,7 +896,7 @@ class PolicyServer:
                     self._step_entry_error(ent, (
                         f"stale episode lease for slot {slot} (evicted "
                         "and reassigned): reset() and resume"
-                    ))
+                    ), lease="stale")
                     continue
             try:
                 obs = np.asarray(ent.msg.get("obs"), np.float32)
@@ -586,37 +905,46 @@ class PolicyServer:
                     ent, f"step obs not coercible to float32: {exc}"
                 )
                 continue
-            if obs.shape != (self.model.obs_dim,):
+            if obs.shape != (head.model.obs_dim,):
                 self._step_entry_error(ent, (
                     f"step obs shape {obs.shape} != "
-                    f"({self.model.obs_dim},)"
+                    f"({head.model.obs_dim},)"
                 ))
                 continue
             batch.append((ent, slot, obs))
+        # skipped other-model entries return to the FRONT in order:
+        # they are older than anything still queued behind them —
+        # ``more`` asks the serve loop to tick again NOW for them
+        # (same-model overflow keeps the admission-window pacing)
+        more = bool(skipped)
+        while skipped:
+            self._queue.appendleft(skipped.pop())
         if not batch:
-            return
+            return more
+        model = head.model
+        stateful = model.slots > 0
         n = len(batch)
         bucket = next((b for b in self.buckets if b >= n),
                       self.buckets[-1])
         for ent, _, _ in batch:
             self.timer.add("queue_wait", t_assemble - ent.t_enq)
-        idx = np.full(bucket, self.model.pad_slot, np.int64)
-        obs_arr = np.zeros((bucket, self.model.obs_dim), np.float32)
+        idx = np.full(bucket, model.pad_slot, np.int64)
+        obs_arr = np.zeros((bucket, model.obs_dim), np.float32)
         pos_before = []
         now = time.monotonic()
         for j, (ent, slot, obs) in enumerate(batch):
             idx[j] = slot if stateful else j
             obs_arr[j] = obs
             if stateful:
-                self._live[slot][1] = now
+                head.live[slot][1] = now
             pos_before.append(
-                int(self.model.pos[slot])
-                if hasattr(self.model, "pos") and stateful else None
+                int(model.pos[slot])
+                if hasattr(model, "pos") and stateful else None
             )
         t_compute = time.perf_counter()
         self.timer.add("batch_assemble", t_compute - t_assemble)
         try:
-            preds = self.model.step_rows(idx, obs_arr)
+            preds = model.step_rows(idx, obs_arr)
         except Exception as exc:  # noqa: BLE001 - server must survive
             logger.exception("policy server: batched step failed")
             for ent, _, _ in batch:
@@ -624,7 +952,7 @@ class PolicyServer:
                     ent, f"batched step failed: {type(exc).__name__}: "
                          f"{exc}"
                 )
-            return
+            return more
         t_reply = time.perf_counter()
         self.timer.add("compute", t_reply - t_compute)
         self.counters.incr("serve_batches")
@@ -637,6 +965,7 @@ class PolicyServer:
             self._finish(ent.ident, ent.msg, reply,
                          span_name="serve:step", t0_us=ent.t0_us)
         self.timer.add("reply", time.perf_counter() - t_reply)
+        return more
 
     # -- serving -------------------------------------------------------------
 
@@ -648,41 +977,29 @@ class PolicyServer:
         after :data:`STATELESS_TTL_S` idle; the ``max(1, ...)`` keeps a
         client that never reset servable instead of deadlocking the
         window."""
-        if self.model.slots > 0:
-            live = len(self._live)
-        else:
-            if self._stateless_eps:
-                cutoff = time.monotonic() - STATELESS_TTL_S
-                for ep, ts in list(self._stateless_eps.items()):
-                    if ts < cutoff:
-                        del self._stateless_eps[ep]
-            live = len(self._stateless_eps)
+        live = 0
+        for st in self._models.values():
+            if st.model.slots > 0:
+                live += len(st.live)
+            else:
+                if st.stateless_eps:
+                    cutoff = time.monotonic() - STATELESS_TTL_S
+                    for ep, ts in list(st.stateless_eps.items()):
+                        if ts < cutoff:
+                            del st.stateless_eps[ep]
+                live += len(st.stateless_eps)
         return min(self.max_batch, max(1, live))
 
     def _drain(self):
         """Admit every request currently sitting on the socket."""
         import zmq
 
-        while True:
-            try:
-                ident, msg = wire.recv_message_router(
-                    self._sock, flags=zmq.NOBLOCK
-                )
-            except zmq.Again:
-                return
-            except zmq.ZMQError:
-                raise  # socket closed: the outer loop shuts down
-            except Exception as exc:  # noqa: BLE001 - server survives
-                # an undecodable frame (garbling proxy, misbehaving
-                # client) is dropped, never fatal: the frames are
-                # consumed, the sender's retry re-sends intact bytes
-                self.counters.incr("serve_errors")
-                logger.warning(
-                    "policy server: undecodable request dropped "
-                    "(%s: %s)", type(exc).__name__, exc,
-                )
-                continue
-            self._admit(ident, msg)
+        drain_socket(
+            lambda: wire.recv_message_router(self._sock,
+                                             flags=zmq.NOBLOCK),
+            lambda out: self._admit(*out),
+            self.counters, "policy server", "request",
+        )
 
     def serve_forever(self, stop_event=None, poll_ms=50):
         import zmq
@@ -716,7 +1033,11 @@ class PolicyServer:
             except zmq.ZMQError:
                 return  # socket closed under us: clean shutdown
             if self._queue:
-                self._tick()
+                # a tick serves one model; entries it skipped for model
+                # mismatch are served by immediate follow-up ticks, not
+                # parked behind another admission window
+                while self._tick():
+                    pass
 
     def _serve_serial(self, stop_event, poll_ms):
         """The REP baseline: one request, one (batch-1) reply."""
@@ -746,7 +1067,7 @@ class PolicyServer:
             except zmq.ZMQError:
                 return
             self._admit(None, msg)
-            if self._queue:
+            while self._queue:
                 self._tick()
 
     def close(self):
@@ -823,8 +1144,8 @@ class ServerProcess:
     def __init__(self, *, model="linear", address=None, seed=0,
                  obs_dim=8, slots=16, length=64, window=None,
                  num_actions=4, int8=False, serial=False, tick_ms=2.0,
-                 max_batch=64, python=None, ready_timeout=60.0,
-                 extra_args=()):
+                 max_batch=64, work_us=0, python=None,
+                 ready_timeout=60.0, extra_args=()):
         from blendjax.replay.shard_client import free_port
 
         self.address = address or f"tcp://127.0.0.1:{free_port()}"
@@ -842,6 +1163,8 @@ class ServerProcess:
             "--tick-ms", str(tick_ms),
             "--max-batch", str(max_batch),
         ]
+        if work_us:
+            self._cmd += ["--work-us", str(work_us)]
         if window is not None:
             self._cmd += ["--window", str(window)]
         if int8:
@@ -925,17 +1248,87 @@ class ServerProcess:
         return False
 
 
+class ServerFleet:
+    """N policy-server replica *processes* behind ONE launcher-
+    compatible surface (a ``launch_info`` spanning every replica +
+    ``respawn(idx)``), so a single :class:`~blendjax.btt.watchdog.
+    FleetWatchdog` supervises the whole serve fleet — the
+    :class:`~blendjax.serve.gateway.ServeGateway`'s supervision story
+    (docs/serving.md).  All replicas share one ``seed`` by default, so
+    every replica serves identical weights (what lease failover needs:
+    after a ``reset()`` any healthy replica continues the workload);
+    pass ``seeds=`` to vary them."""
+
+    def __init__(self, replicas, *, seed=0, seeds=None, **kwargs):
+        if seeds is not None and len(seeds) != replicas:
+            raise ValueError(
+                f"seeds has {len(seeds)} entries for {replicas} replicas"
+            )
+        self._procs = [
+            ServerProcess(seed=(seeds[i] if seeds is not None else seed),
+                          **kwargs)
+            for i in range(int(replicas))
+        ]
+        self.launch_info = None
+
+    @property
+    def addresses(self):
+        return [p.address for p in self._procs]
+
+    def __enter__(self):
+        try:
+            # spawn every replica first, then wait: startup overlaps
+            for p in self._procs:
+                p.launch_info = _ServeLaunchInfo([p._spawn()],
+                                                 [p.address])
+            for p in self._procs:
+                p.wait_ready(p.ready_timeout)
+        except BaseException:
+            self.close()
+            raise
+        self.launch_info = _ServeLaunchInfo(
+            [p.launch_info.processes[0] for p in self._procs],
+            self.addresses,
+        )
+        return self
+
+    def respawn(self, idx):
+        """Relaunch replica ``idx`` with its original command line (the
+        watchdog's contract)."""
+        proc = self._procs[idx].respawn(0)
+        self.launch_info.processes[idx] = proc
+        return proc
+
+    def close(self):
+        for p in self._procs:
+            p.close()
+        self.launch_info = None
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 # ---------------------------------------------------------------------------
 # process entry point
 # ---------------------------------------------------------------------------
 
 
-def build_model(args):
+def build_model(args, kind=None, seed=None):
     """Deterministic model construction from CLI args (seeded init —
-    what makes a respawned server byte-identical to its predecessor)."""
+    what makes a respawned server byte-identical to its predecessor).
+    ``kind``/``seed`` override the args' own (the ``--extra-model``
+    path builds secondary hosted models through the same code)."""
+    if kind is not None or seed is not None:
+        args = argparse.Namespace(**{
+            **vars(args),
+            "model": kind if kind is not None else args.model,
+            "seed": seed if seed is not None else args.seed,
+        })
     if args.model == "linear":
         return LinearModel(obs_dim=args.obs_dim, slots=args.slots,
-                           seed=args.seed)
+                           seed=args.seed,
+                           work_us=getattr(args, "work_us", 0))
     import jax
 
     key = jax.random.PRNGKey(args.seed)
@@ -979,10 +1372,33 @@ def main(argv=None):
     ap.add_argument("--serial", action="store_true")
     ap.add_argument("--tick-ms", type=float, default=2.0)
     ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--work-us", type=float, default=0,
+                    help="linear model only: sleep-based per-row "
+                         "compute stand-in (gateway scale-out bench)")
+    ap.add_argument(
+        "--extra-model", action="append", default=[],
+        metavar="NAME=KIND",
+        help="host an additional model under NAME (multi-model "
+             "routing); the i'th extra model inits from seed+1+i, so a "
+             "respawned server rebuilds every hosted model "
+             "deterministically from the one command line",
+    )
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    model = build_model(args)
+    if args.extra_model:
+        models = {model.kind: model}
+        for i, spec in enumerate(args.extra_model):
+            name, sep, kind = spec.partition("=")
+            if not sep or not name or not kind:
+                ap.error(f"--extra-model needs NAME=KIND, got {spec!r}")
+            if name in models:
+                ap.error(f"duplicate hosted model name {name!r}")
+            models[name] = build_model(args, kind=kind,
+                                       seed=args.seed + 1 + i)
+        model = models
     server = PolicyServer(
-        args.address, build_model(args), serial=args.serial,
+        args.address, model, serial=args.serial,
         tick_ms=args.tick_ms, max_batch=args.max_batch,
     )
     stop = threading.Event()

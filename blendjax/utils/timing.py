@@ -137,12 +137,68 @@ REPLAY_EVENTS = (
 #: ``serve_errors`` — requests that errored: answered with an error
 #: reply, or (batched mode only) dropped because their frames were
 #: undecodable — the one case with no reply, healed by the client's
-#: retry.
+#: retry;
+#: ``serve_prefills`` — episodes admitted WITH a T-step observation
+#: prefix replayed in one teacher-forced batched pass (docs/serving.md
+#: "Batched prefill admission") instead of T serial decode steps.
 SERVE_EVENTS = (
     "serve_requests", "serve_replies", "serve_batches",
     "serve_batch_pad", "serve_cache_hits", "serve_dup_inflight",
     "serve_resets", "serve_closes", "serve_evictions",
-    "serve_slot_denied", "serve_errors",
+    "serve_slot_denied", "serve_errors", "serve_prefills",
+)
+
+#: Canonical serve-gateway event names (see docs/serving.md
+#: "ServeGateway").  Same contract as ``FLEET_EVENTS``: any
+#: ``EventCounters`` accepts them and the TelemetryHub zero-fills every
+#: name in every scrape.  The gateway's per-request counters carry the
+#: ``gateway_`` prefix INSTEAD of reusing the ``serve_*`` vocabulary,
+#: so a hub that registers the gateway AND its replicas (the documented
+#: setup) folds distinct names — one client request must not read as
+#: two ``serve_requests`` in the merged scrape.
+#: ``gateway_requests`` — client requests admitted at the front (any
+#: command);
+#: ``gateway_replies`` — replies sent to clients (forwarded replica
+#: replies AND gateway-local answers, errors included);
+#: ``gateway_errors`` — requests the gateway errored or dropped
+#: (unknown command, no healthy replica, undecodable frames);
+#: ``gateway_cache_hits`` — retries answered from the gateway's
+#: mutating-reply cache (exactly-once: the fleet never sees them);
+#: ``gateway_dup_inflight`` — retries of a still-in-flight forward
+#: re-sent to the SAME replica (whose dedupe keeps them exactly-once);
+#: ``gateway_routed`` — requests forwarded to a replica (any command);
+#: ``gateway_affinity_hits`` — step/close requests routed by a live
+#: episode lease to the replica that owns its KV-cache row;
+#: ``gateway_rebalances`` — fresh-episode routes where the load ranking
+#: (queue depth + SERVE_STAGES p99 from the cached telemetry scrape)
+#: overrode plain rotation;
+#: ``gateway_replica_quarantined`` — a replica stopped answering (scrape
+#: timeout, or the watchdog reported its death) and was isolated: its
+#: leases are invalidated and fresh episodes avoid it;
+#: ``gateway_replica_respawns`` — a quarantined replica answered a
+#: scrape again (watchdog respawn landed) and rejoined the route set;
+#: ``gateway_stale_lease_redirects`` — step/close requests whose lease
+#: pointed at a dead/forgotten episode, answered with the actionable
+#: stale-lease error (the client ``reset()``s onto a healthy replica);
+#: ``gateway_drains`` — replicas put into drain (no fresh episodes,
+#: live ones finish).
+GATEWAY_EVENTS = (
+    "gateway_requests", "gateway_replies", "gateway_errors",
+    "gateway_cache_hits", "gateway_dup_inflight",
+    "gateway_routed", "gateway_affinity_hits", "gateway_rebalances",
+    "gateway_replica_quarantined", "gateway_replica_respawns",
+    "gateway_stale_lease_redirects", "gateway_drains",
+)
+
+#: Canonical serve-gateway stage names (see docs/serving.md), the
+#: :class:`StageTimer` vocabulary :class:`~blendjax.serve.gateway.
+#: ServeGateway` reports under: ``gw_route`` (request decode + routing
+#: decision), ``gw_forward`` (re-encode + send to the chosen replica),
+#: ``gw_reply`` (replica reply receive + forward back to the client).
+#: Prefixed ``gw_`` so the hub's union stage namespace cannot alias the
+#: server-side ``reply`` stage.
+GATEWAY_STAGES = (
+    "gw_route", "gw_forward", "gw_reply",
 )
 
 #: Canonical policy-serving stage names (see docs/serving.md), the

@@ -55,6 +55,9 @@ DEFAULT_FLOORS = {
     "serve_qps": 0.80,              # serving tier headline (docs/serving.md)
     "serve_batch_x": 0.80,
     "serve_int8_x": 0.80,
+    "serve_prefill_x": 0.80,        # batched prefill admission vs serial
+    "gateway_qps": 0.80,            # serve-fleet aggregate through the gateway
+    "gateway_scale_x": 0.80,        # QPS at N replicas over 1 (drained fleet)
 }
 
 #: metric -> maximum acceptable new/old ratio for LOWER-is-better
@@ -62,6 +65,7 @@ DEFAULT_FLOORS = {
 #: guardrail is a ceiling, not a floor.  Override via --ceiling.
 DEFAULT_CEILINGS = {
     "serve_p99_ms": 1.30,           # tail latency; loopback-noise slack
+    "gateway_p99_ms": 1.30,         # fleet tail latency through the gateway
 }
 
 #: fallback floor for numeric metrics named via --metrics that have no
@@ -115,10 +119,16 @@ def _flatten(doc, metrics):
     sb = doc.get("serve_bench")
     if isinstance(sb, dict):
         for k in ("serve_qps", "serve_p99_ms", "serve_batch_x",
-                  "serve_int8_x"):
+                  "serve_int8_x", "serve_prefill_x"):
             if isinstance(sb.get(k), (int, float)) \
                     and not isinstance(sb.get(k), bool):
                 metrics[k] = float(sb[k])
+    gb = doc.get("gateway_bench")
+    if isinstance(gb, dict):
+        for k in ("gateway_qps", "gateway_p99_ms", "gateway_scale_x"):
+            if isinstance(gb.get(k), (int, float)) \
+                    and not isinstance(gb.get(k), bool):
+                metrics[k] = float(gb[k])
 
 
 def _regex_salvage(text, metrics):

@@ -36,6 +36,8 @@ from blendjax.obs.spans import (
 from blendjax.utils.timing import (
     FEED_STAGES,
     FLEET_EVENTS,
+    GATEWAY_EVENTS,
+    GATEWAY_STAGES,
     REPLAY_EVENTS,
     REPLAY_STAGES,
     SERVE_EVENTS,
@@ -205,9 +207,11 @@ def test_scrape_zero_fill_contract():
     hub = TelemetryHub()
     hub.register("fresh", counters=EventCounters(), timer=StageTimer())
     snap = hub.scrape()
-    for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS:
+    for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS \
+            + GATEWAY_EVENTS:
         assert snap["counters"][name] == 0, name
-    for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES:
+    for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES \
+            + GATEWAY_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
@@ -708,6 +712,34 @@ def test_documented_serve_stages_exist_in_tuples():
         "## Stage vocabulary",
     )
     vocab = set(SERVE_STAGES)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_gateway_counters_exist_in_tuples():
+    """The gateway vocabulary lock (ISSUE-11 satellite): every
+    ``GATEWAY_EVENTS`` counter docs/serving.md tabulates exists in the
+    tuple and every tuple name is tabulated — both directions, same
+    contract as the fleet/replay/serve vocabularies."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "serving.md"),
+        "## Gateway counter vocabulary",
+    )
+    vocab = set(GATEWAY_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_gateway_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "serving.md"),
+        "## Gateway stage vocabulary",
+    )
+    vocab = set(GATEWAY_STAGES)
     missing = [n for n in names if n not in vocab]
     assert not missing, f"documented but not in tuples: {missing}"
     absent = [n for n in vocab if n not in set(names)]

@@ -191,6 +191,180 @@ def test_per_row_cache_shapes_and_reset_masks_stale_rows():
 
 
 # ---------------------------------------------------------------------------
+# batched prefill admission (ISSUE-11): one teacher-forced pass == T
+# serial decode steps
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kwargs,window",
+    [
+        (dict(), None),
+        (dict(), 4),
+        (dict(pos_encoding="rope"), None),
+        (dict(pos_encoding="rope"), 4),
+        (dict(n_kv_heads=2), None),
+    ],
+    ids=["learned", "learned-windowed", "rope", "rope-windowed", "gqa"],
+)
+def test_prefill_admission_matches_serial_decode(kwargs, window):
+    """Batched prefill (ONE teacher-forced pass filling the slot's KV
+    rows) must agree with T serial ``decode_step``s across the PR-10
+    parity matrix: the prefill prediction equals the T'th serial
+    prediction, and every LATER step decodes identically — the cache
+    the prefill wrote is byte-equivalent to the serially-built one."""
+    import jax
+    import jax.numpy as jnp
+
+    from blendjax.models import seqformer
+    from blendjax.serve.server import SeqFormerModel
+
+    params = seqformer.init(
+        jax.random.PRNGKey(0), obs_dim=5, d_model=32, n_heads=4,
+        n_layers=2, max_len=32, **kwargs,
+    )
+    rng = np.random.default_rng(2)
+    ep = rng.standard_normal((9, 5)).astype(np.float32)
+    t0 = 5
+    want = _serial_decode(params, [ep], 16, window)[0]
+    model = SeqFormerModel(params, slots=3, length=16, window=window,
+                           compute_dtype=jnp.float32)
+    pred = model.prefill_rows(np.asarray([1]), ep[:t0])
+    np.testing.assert_allclose(pred, want[t0 - 1], atol=1e-5, rtol=1e-5)
+    for t in range(t0, len(ep)):
+        got = model.step_rows(np.asarray([1]), ep[t][None])[0]
+        np.testing.assert_allclose(got, want[t], atol=1e-5, rtol=1e-5)
+
+
+def test_prefill_reset_end_to_end_and_validation():
+    """The wire path: ``reset(prefix=...)`` admits mid-sequence (pred/
+    pos in the reply, ``serve_prefills`` counted), and malformed or
+    unservable prefixes error actionably with the slot RELEASED."""
+    from blendjax.serve import (
+        LinearModel,
+        PolicyModel,
+        ServeClient,
+        start_server_thread,
+    )
+
+    counters = EventCounters()
+    with start_server_thread(
+        LinearModel(obs_dim=4, slots=1, seed=0), counters=counters,
+    ) as h:
+        c = ServeClient(h.address, fault_policy=FaultPolicy(max_retries=0))
+        rng = np.random.default_rng(1)
+        prefix = rng.standard_normal((5, 4)).astype(np.float32)
+        ref = LinearModel(obs_dim=4, slots=1, seed=0)
+        reply = c.reset(prefix=prefix)
+        assert reply["pos"] == 5
+        np.testing.assert_allclose(
+            reply["pred"], ref.prefill_rows(np.asarray([0]), prefix)
+        )
+        r = c.step(prefix[0])
+        assert r["pos"] == 5
+        assert c.close_episode()
+        # a bad prefix shape errors AND releases the (only) slot
+        with pytest.raises(RuntimeError, match="prefix shape"):
+            c.reset(prefix=np.zeros((3, 9), np.float32))
+        c.reset(prefix=prefix)  # the slot came back
+        assert c.close_episode()
+        assert _serve_counts(counters)["serve_prefills"] == 2
+        c.close()
+    # stateless models refuse prefill admission actionably
+    import jax
+
+    from blendjax.models import policy
+
+    params = policy.init(jax.random.PRNGKey(0), 4, 3)
+    with start_server_thread(PolicyModel(params, 4)) as h:
+        c = ServeClient(h.address, fault_policy=FaultPolicy(max_retries=0))
+        with pytest.raises(RuntimeError, match="stateless"):
+            c.reset(prefix=np.zeros((3, 4), np.float32))
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-model hosting (ISSUE-11)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_model_server_per_model_pools_and_routing():
+    """One server hosting two models: requests route by the envelope's
+    model id (per-seed weight witness), each model owns its OWN slot
+    pool (one model's exhaustion cannot deny the other), and an unknown
+    model id errors actionably."""
+    from blendjax.serve import LinearModel, ServeClient, start_server_thread
+
+    obs = np.arange(4, dtype=np.float32)
+    with start_server_thread({
+        "a": LinearModel(obs_dim=4, slots=1, seed=0),
+        "b": LinearModel(obs_dim=4, slots=2, seed=7),
+    }) as h:
+        ca = ServeClient(h.address, model="a",
+                         fault_policy=FaultPolicy(max_retries=0))
+        cb = ServeClient(h.address, model="b",
+                         fault_policy=FaultPolicy(max_retries=0))
+        hello = ca.hello()
+        assert set(hello["models"]) == {"a", "b"}
+        ca.reset()
+        cb.reset()
+        wa = LinearModel(obs_dim=4, slots=1, seed=0).w
+        wb = LinearModel(obs_dim=4, slots=2, seed=7).w
+        np.testing.assert_allclose(ca.step(obs)["pred"], obs @ wa)
+        np.testing.assert_allclose(cb.step(obs)["pred"], obs @ wb)
+        # model a is full (1 slot); model b still admits
+        ca2 = ServeClient(h.address, model="a",
+                          fault_policy=FaultPolicy(max_retries=0))
+        with pytest.raises(RuntimeError, match="no free episode slot"):
+            ca2.reset()
+        cb2 = ServeClient(h.address, model="b")
+        cb2.reset()
+        bogus = ServeClient(h.address, model="nope",
+                            fault_policy=FaultPolicy(max_retries=0))
+        with pytest.raises(RuntimeError, match="unknown model"):
+            bogus.reset()
+        for c in (ca, cb, ca2, cb2, bogus):
+            c.close()
+
+
+def test_multi_model_single_workload_replies_identical():
+    """The ISSUE-11 parity bar: a multi-model server hosting ONE model
+    answers a single-model workload with replies identical to a plain
+    single-model server — same keys, same values, same bytes in the
+    prediction rows."""
+    from blendjax.serve import LinearModel, ServeClient, start_server_thread
+
+    def run_workload(address):
+        c = ServeClient(address)
+        out = []
+        obs = np.linspace(-1, 1, 4).astype(np.float32)
+        out.append(("hello", c.hello()))
+        c.reset()
+        out.append(("reset", {"slot": c.slot, "episode": c.episode}))
+        for t in range(3):
+            out.append(("step", c.step(obs + t)))
+        out.append(("close", {"closed": c.close_episode()}))
+        c.close()
+        return out
+
+    with start_server_thread(LinearModel(obs_dim=4, slots=2, seed=0)) as h:
+        single = run_workload(h.address)
+    with start_server_thread(
+        {"linear": LinearModel(obs_dim=4, slots=2, seed=0)}
+    ) as h:
+        multi = run_workload(h.address)
+    assert len(single) == len(multi)
+    for (ks, vs), (km, vm) in zip(single, multi):
+        assert ks == km
+        assert set(vs) == set(vm), (ks, set(vs), set(vm))
+        for key in vs:
+            if isinstance(vs[key], np.ndarray):
+                assert vs[key].tobytes() == vm[key].tobytes(), (ks, key)
+            elif key != "pid":
+                assert vs[key] == vm[key], (ks, key)
+
+
+# ---------------------------------------------------------------------------
 # PolicyServer: batching, slots, counters
 # ---------------------------------------------------------------------------
 
