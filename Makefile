@@ -10,8 +10,8 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
-	rlbench rlbench-sharded replaybench servebench gatewaybench \
-	multichip dryrun benchdiff obsdemo
+	rlbench rlbench-sharded replaybench shmbench servebench \
+	gatewaybench multichip dryrun benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -134,6 +134,17 @@ multichip:
 replaybench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/replay_benchmark.py \
 		--batch 32 --seconds 6 --sharded
+
+# ShmRPC transport microbench (docs/transport.md): the replay-service
+# windows with BOTH wires interleaved over the same shard servers —
+# replay_shard_x from the shm arm (the storage tier's wire tax after
+# the shared-memory transport) and shm_rpc_x (shm over loopback ZMQ at
+# the median pair; floor trajectory-guarded in bench_compare).  Longer
+# windows than replaybench: this is the transport's dedicated entry
+# point.
+shmbench:
+	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/replay_benchmark.py \
+		--batch 32 --seconds 10 --sharded --transport shm
 
 # Policy-serving microbench (docs/serving.md): 8 concurrent episode
 # clients against one continuously-batched seqformer world-model

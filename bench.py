@@ -445,6 +445,7 @@ HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
     ("serve_int8_x",),
     ("serve_prefill_x",),
+    ("shm_rpc_x",),
     ("replay_shard_x", "replay_degraded_x"),
     ("serve_batch_x",),
     ("gateway_qps", "gateway_p99_ms"),
@@ -485,9 +486,14 @@ def headline(out):
     shard = (rb or {}).get("sharded")
     if shard and shard.get("replay_shard_x") is not None:
         # replay-service sampling rate over in-process (the wire tax of
-        # the sharded storage tier), with the degraded-mode overhead
+        # the sharded storage tier — the service arm rides ShmRPC by
+        # default since ISSUE-12), with the degraded-mode overhead
         # (one shard quarantined, strata renormalized) alongside
         line["replay_shard_x"] = shard["replay_shard_x"]
+        if shard.get("shm_rpc_x") is not None:
+            # the shared-memory transport over loopback ZMQ at the
+            # median interleaved pair (docs/transport.md)
+            line["shm_rpc_x"] = shard["shm_rpc_x"]
         if shard.get("replay_degraded_x") is not None:
             line["replay_degraded_x"] = shard["replay_degraded_x"]
     if out.get("rl_pipelined_x") is not None:

@@ -37,13 +37,19 @@ REPLAY_BENCH_KEYS = (
 #: Keys of the ``--sharded`` sub-record (``replay_bench["sharded"]``):
 #: in-process vs replay-*service* sampling over interleaved windows.
 #: ``replay_shard_x`` is service/in-process at the median pair (the wire
-#: tax of the storage tier); ``replay_degraded_x`` is degraded/healthy
+#: tax of the storage tier; the service arm rides the ``transport``
+#: wire — ShmRPC by default since ISSUE-12); ``shm_rpc_x`` is the
+#: shm-arm/tcp-arm ratio at the median pair (what the shared-memory
+#: transport recovers over loopback ZMQ + pickle framing; None when
+#: ShmRPC is unavailable); ``replay_degraded_x`` is degraded/healthy
 #: service rate with one shard quarantined (the strata-renormalization
 #: overhead a shard outage costs).
 REPLAY_SHARD_KEYS = (
-    "shards", "capacity", "batch",
-    "replay_shard_batches_per_sec",  # {"inproc", "service", "service_degraded"}
+    "shards", "capacity", "batch", "transport",
+    "replay_shard_batches_per_sec",  # {"inproc", "service",
+    #                                   "service_tcp", "service_degraded"}
     "replay_shard_x",
+    "shm_rpc_x",
     "replay_degraded_x",
 )
 

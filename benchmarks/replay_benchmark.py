@@ -196,14 +196,25 @@ def measure_record(width=160, height=120, channels=3, seconds=1.0,
 
 
 def measure_sharded(width=160, height=120, channels=3, batch=32,
-                    capacity=2048, shards=2, seconds=4.0, seed=0):
+                    capacity=2048, shards=2, seconds=4.0, seed=0,
+                    transport="shm"):
     """In-process vs service sampling in interleaved windows, plus the
     degraded-mode overhead (one shard quarantined mid-measurement and
     re-admitted after) — the ``replay_shard_x`` / ``replay_degraded_x``
-    record.  Keys locked by ``REPLAY_SHARD_KEYS``."""
+    record.  Keys locked by ``REPLAY_SHARD_KEYS``.
+
+    ISSUE-12: the service runs TWO clients over the same shard servers
+    — one upgraded to the ShmRPC transport, one pinned to loopback ZMQ
+    — in the same interleaved rounds.  ``transport`` selects which arm
+    feeds ``replay_shard_x`` (and the degraded window); ``shm_rpc_x``
+    is the shm/tcp ratio at the median pair — the wire tax the
+    shared-memory transport recovers.  When ShmRPC is unavailable
+    (kill-switch, no native layer), the shm arm is skipped and
+    ``shm_rpc_x`` is None."""
     import numpy as np
 
     from benchmarks._common import REPLAY_SHARD_KEYS
+    from blendjax.btt import shm_rpc
     from blendjax.replay import ReplayBuffer, ShardedReplay
     from blendjax.replay.service import start_shard_thread
 
@@ -222,20 +233,41 @@ def measure_sharded(width=160, height=120, channels=3, batch=32,
         start_shard_thread(capacity // shards, shard_id=i)
         for i in range(shards)
     ]
+    shm_ok = shm_rpc.enabled()
+    if transport == "shm" and not shm_ok:
+        transport = "tcp"
     try:
-        service = ShardedReplay(
-            [h.address for h in handles], seed=seed
+        service_tcp = ShardedReplay(
+            [h.address for h in handles], seed=seed, shm=False,
         )
-        _fill(service, transitions, fill)
+        _fill(service_tcp, transitions, fill)
+        service_shm = None
+        if shm_ok:
+            # SAME shard servers, same rows, same draw stream — only
+            # the wire differs (rows were already stored by the tcp
+            # client's fill; this client adopts the layout by filling
+            # its own eligibility state over the same slots)
+            service_shm = ShardedReplay(
+                [h.address for h in handles], seed=seed,
+            )
+            _fill(service_shm, transitions, fill)
+        primary = service_shm if transport == "shm" else service_tcp
         win = 0.25
-        rounds = max(4, int(seconds / (3 * win)))
-        _run_columnar(inproc, batch, 0.1)   # warmup all three paths
-        _run_columnar(service, batch, 0.1)
+        wins_per_round = 3 + (1 if service_shm is not None else 0)
+        rounds = max(4, int(seconds / (wins_per_round * win)))
+        _run_columnar(inproc, batch, 0.1)   # warmup every path
+        _run_columnar(service_tcp, batch, 0.1)
+        if service_shm is not None:
+            _run_columnar(service_shm, batch, 0.1)
         pairs = []
+        wire_pairs = []
         degraded_pairs = []
         for _ in range(rounds):
             inn, int_ = _run_columnar(inproc, batch, win)
-            svn, svt = _run_columnar(service, batch, win)
+            tcn, tct = _run_columnar(service_tcp, batch, win)
+            shn, sht = 0, 1.0
+            if service_shm is not None:
+                shn, sht = _run_columnar(service_shm, batch, win)
             # degraded window: quarantine the last shard (its rows leave
             # the draw domain, strata renormalize), then re-admit via
             # the normal probe handshake — the shard thread never died,
@@ -245,19 +277,31 @@ def measure_sharded(width=160, height=120, channels=3, batch=32,
             # leaves nothing drawable), so the window is skipped.
             dgn, dgt = 0, 1.0
             if shards > 1:
-                service.quarantine_shard(shards - 1, reason="bench window")
-                dgn, dgt = _run_columnar(service, batch, win)
-                if not service.probe():
+                primary.quarantine_shard(shards - 1,
+                                         reason="bench window")
+                dgn, dgt = _run_columnar(primary, batch, win)
+                if not primary.probe():
                     raise RuntimeError("bench shard failed to re-admit")
-            rate_in, rate_sv, rate_dg = inn / int_, svn / svt, dgn / dgt
+            rate_in = inn / int_
+            rate_tc = tcn / tct
+            rate_sh = shn / sht
+            rate_sv = rate_sh if transport == "shm" else rate_tc
+            rate_dg = dgn / dgt
             if rate_in > 0:
                 pairs.append((rate_sv / rate_in, rate_in, rate_sv))
+            if service_shm is not None and rate_tc > 0:
+                wire_pairs.append((rate_sh / rate_tc, rate_sh, rate_tc))
             if shards > 1 and rate_sv > 0:
                 degraded_pairs.append((rate_dg / rate_sv, rate_dg))
         pairs.sort()
+        wire_pairs.sort()
         degraded_pairs.sort()
         ratio, rate_in, rate_sv = (
             pairs[len(pairs) // 2] if pairs else (0.0, 0.0, 0.0)
+        )
+        wire_x, rate_sh, rate_tc = (
+            wire_pairs[len(wire_pairs) // 2]
+            if wire_pairs else (None, 0.0, 0.0)
         )
         dg_ratio, rate_dg = (
             degraded_pairs[len(degraded_pairs) // 2]
@@ -267,17 +311,24 @@ def measure_sharded(width=160, height=120, channels=3, batch=32,
             "shards": shards,
             "capacity": capacity,
             "batch": batch,
+            "transport": transport,
             "replay_shard_batches_per_sec": {
                 "inproc": round(rate_in, 2),
                 "service": round(rate_sv, 2),
+                "service_tcp": round(rate_tc, 2),
                 "service_degraded": round(rate_dg, 2),
             },
             "replay_shard_x": round(ratio, 3) if pairs else None,
+            "shm_rpc_x": (
+                round(wire_x, 3) if wire_x is not None else None
+            ),
             "replay_degraded_x": (
                 round(dg_ratio, 3) if degraded_pairs else None
             ),
         }
-        service.close()
+        service_tcp.close()
+        if service_shm is not None:
+            service_shm.close()
     finally:
         for h in handles:
             h.close()
@@ -287,10 +338,11 @@ def measure_sharded(width=160, height=120, channels=3, batch=32,
 
 
 def measure(width=160, height=120, channels=3, batch=32, capacity=4096,
-            seconds=6.0, seed=0, sharded=0):
+            seconds=6.0, seed=0, sharded=0, transport="shm"):
     """The full replay_bench record (keys: ``REPLAY_BENCH_KEYS``;
     ``sharded`` > 0 adds the service comparison over that many
-    in-process shards under ``"sharded"``)."""
+    in-process shards under ``"sharded"``, with ``transport``
+    selecting the primary service arm — see :func:`measure_sharded`)."""
     from benchmarks._common import REPLAY_BENCH_KEYS
 
     budget = max(seconds, 3.0)
@@ -329,7 +381,7 @@ def measure(width=160, height=120, channels=3, batch=32, capacity=4096,
         rec["sharded"] = measure_sharded(
             width, height, channels, batch=batch,
             capacity=min(capacity, 2048), shards=sharded,
-            seconds=0.6 * budget, seed=seed,
+            seconds=0.6 * budget, seed=seed, transport=transport,
         )
     missing = [k for k in REPLAY_BENCH_KEYS if k not in rec]
     assert not missing, f"replay_bench schema drifted: missing {missing}"
@@ -350,10 +402,14 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--sharded", action="store_true",
                     help="add the in-process vs replay-service windows "
-                         "(replay_shard_x) and the degraded-mode "
-                         "overhead (replay_degraded_x)")
+                         "(replay_shard_x), the shm-vs-tcp wire ratio "
+                         "(shm_rpc_x) and the degraded-mode overhead "
+                         "(replay_degraded_x)")
     ap.add_argument("--shards", type=int, default=2,
                     help="shard count for --sharded")
+    ap.add_argument("--transport", choices=("shm", "tcp"), default="shm",
+                    help="which service arm feeds replay_shard_x; both "
+                         "arms run interleaved either way (shm_rpc_x)")
     args = ap.parse_args()
     print(
         json.dumps(
@@ -368,6 +424,7 @@ def main():
                     seconds=args.seconds,
                     seed=args.seed,
                     sharded=args.shards if args.sharded else 0,
+                    transport=args.transport,
                 ),
             }
         ),

@@ -37,6 +37,8 @@ _LAZY = {
     "FaultPolicy": ("blendjax.btt.faults", "FaultPolicy"),
     "CircuitOpenError": ("blendjax.btt.faults", "CircuitOpenError"),
     "ChaosProxy": ("blendjax.btt.chaos", "ChaosProxy"),
+    "ShmChaos": ("blendjax.btt.shm_rpc", "ShmChaos"),
+    "RpcChannel": ("blendjax.btt.transport", "RpcChannel"),
     "get_primary_ip": ("blendjax.btt.utils", "get_primary_ip"),
 }
 
@@ -60,6 +62,9 @@ _LAZY_MODULES = (
     "faults",
     "chaos",
     "torch_compat",
+    "shm_rpc",
+    "transport",
+    "rpc",
     "utils",
     "constants",
     "apps",

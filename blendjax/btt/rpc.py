@@ -35,7 +35,12 @@ def exactly_once_rpc(socket_fn, msg, *, policy, state, counters,
     Params
     ------
     socket_fn: callable
-        Zero-arg callable returning the (lazily dialed) DEALER socket.
+        Zero-arg callable returning either the (lazily dialed) DEALER
+        socket, or a transport channel
+        (:class:`blendjax.btt.transport.RpcChannel`): anything with
+        ``send_request``/``poll_reply``/``recv_reply`` — which is how
+        the same discipline rides the shm transport unchanged
+        (docs/transport.md).
     msg: dict
         The request, ``cmd`` included; stamped with a fresh correlation
         id here (a fault-policy retry re-sends the SAME stamped dict).
@@ -71,39 +76,54 @@ def exactly_once_rpc(socket_fn, msg, *, policy, state, counters,
 
     def attempt(n):
         sock = socket_fn()
-        wire.send_message_dealer(sock, msg, raw_buffers=raw_buffers)
+        channel = hasattr(sock, "send_request")
+        if channel:
+            sock.send_request(msg, raw_buffers=raw_buffers)
+        else:
+            wire.send_message_dealer(sock, msg, raw_buffers=raw_buffers)
         deadline = time.monotonic() + wait_ms / 1000.0
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                if channel:
+                    # over shm a missed deadline is the peer-death
+                    # signal: demote so the retry rides ZMQ
+                    sock.notify_timeout()
                 raise exc_factory(
                     f"no reply to {cmd!r} within {wait_ms} ms "
                     f"(attempt {n + 1})"
                 )
-            if sock.poll(max(1, min(50, int(remaining * 1000))),
-                         zmq.POLLIN):
-                reply = wire.recv_message_dealer(sock)
-                if reply.get(wire.BTMID_KEY) != mid:
-                    # a previous attempt's late reply (or a dead
-                    # incarnation's): this request's reply is still
-                    # owed — keep waiting
-                    counters.incr("stale_replies")
+            slice_ms = max(1, min(50, int(remaining * 1000)))
+            if channel:
+                reply = (sock.recv_reply()
+                         if sock.poll_reply(slice_ms) else None)
+                if reply is None:
+                    continue  # spurious wakeup (wrap marker / dropped)
+            else:
+                if not sock.poll(slice_ms, zmq.POLLIN):
                     continue
-                piggyback = wire.pop_spans(reply)
-                if spans is not None:
-                    spans.ingest(piggyback)
-                    spans.record(make_span(
-                        f"{span_label}:{cmd}", t0_us, trace=mid,
-                        cat=span_cat, args=span_args,
-                    ))
-                if "error" in reply:
-                    raise RuntimeError(
-                        f"{remote_name}: {cmd!r} failed remotely: "
-                        f"{reply['error']}"
-                    )
-                if pop_mid:
-                    reply.pop(wire.BTMID_KEY, None)
-                return reply
+                reply = wire.recv_message_dealer(sock)
+            if reply.get(wire.BTMID_KEY) != mid:
+                # a previous attempt's late reply (or a dead
+                # incarnation's): this request's reply is still
+                # owed — keep waiting
+                counters.incr("stale_replies")
+                continue
+            piggyback = wire.pop_spans(reply)
+            if spans is not None:
+                spans.ingest(piggyback)
+                spans.record(make_span(
+                    f"{span_label}:{cmd}", t0_us, trace=mid,
+                    cat=span_cat, args=span_args,
+                ))
+            if "error" in reply:
+                raise RuntimeError(
+                    f"{remote_name}: {cmd!r} failed remotely: "
+                    f"{reply['error']}"
+                )
+            if pop_mid:
+                reply.pop(wire.BTMID_KEY, None)
+            return reply
 
     try:
         return policy.run(

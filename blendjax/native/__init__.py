@@ -2,6 +2,7 @@
 everything here is optional — the pure-ZMQ paths work without it."""
 
 from blendjax.native.ring import (  # noqa: F401
+    DoorBell,
     ShmRingReader,
     ShmRingWriter,
     copy_into,

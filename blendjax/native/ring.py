@@ -114,6 +114,18 @@ def _bind(lib):
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_uint64,
     ]
+    # zero-copy writer (bjr_write_begin/commit): OPTIONAL — a prebuilt
+    # .so from an older source may lack it, and that must not take the
+    # whole native layer down (the feed path needs none of it)
+    try:
+        lib.bjr_write_begin.restype = ctypes.c_void_p
+        lib.bjr_write_begin.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.bjr_write_commit.argtypes = [ctypes.c_void_p]
+        lib.bjx_has_write_begin = True
+    except AttributeError:
+        lib.bjx_has_write_begin = False
 
 
 def native_available() -> bool:
@@ -200,6 +212,7 @@ class ShmRingWriter:
                 f"native ring unavailable (build failed: {_LIB_ERR}); use tcp"
             )
         self._lib = lib
+        self.capacity_bytes = int(capacity_bytes)
         name = shm_name_from_address(address)
         self._h = lib.bjr_create(name.encode(), capacity_bytes)
         if not self._h:
@@ -212,6 +225,11 @@ class ShmRingWriter:
         directly into the shm arena by ``bjr_write_v`` with the GIL
         released — no Python-side join.
         """
+        if self._h is None:
+            # a closed writer must fail as an I/O error, not hand the
+            # native layer a NULL handle (instant segfault): an RPC
+            # server can race a reply against its own channel drop
+            raise OSError("shm ring writer is closed")
         n = len(frames)
         ptrs = (ctypes.c_void_p * n)()
         lens = (ctypes.c_uint64 * n)()
@@ -227,8 +245,38 @@ class ShmRingWriter:
             raise ValueError("message larger than ring capacity")
         return rc == 0
 
+    def begin_record(self, nbytes, timeout_ms=-1):
+        """Reserve a ``nbytes`` record and return a writable ``uint8``
+        view INTO the ring arena (the zero-copy writer: a columnar
+        gather lands its batch straight in shared memory, skipping the
+        staging copy :meth:`send_frames` would pay).  The record is
+        invisible to the reader until :meth:`commit_record`.  Returns
+        None on timeout or when the native layer predates the API;
+        raises ValueError when the record cannot fit the ring at all.
+        """
+        import numpy as np
+
+        if self._h is None:
+            raise OSError("shm ring writer is closed")
+        if not getattr(self._lib, "bjx_has_write_begin", False):
+            return None
+        padded = (nbytes + 7) & ~7
+        if 8 + padded + 8 > self.capacity_bytes:
+            raise ValueError("message larger than ring capacity")
+        ptr = self._lib.bjr_write_begin(self._h, nbytes, timeout_ms)
+        if not ptr:
+            return None
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        return np.frombuffer(buf, np.uint8)
+
+    def commit_record(self):
+        """Publish the record reserved by :meth:`begin_record`."""
+        if self._h is None:
+            raise OSError("shm ring writer is closed")
+        self._lib.bjr_write_commit(self._h)
+
     def pending_bytes(self):
-        return self._lib.bjr_pending(self._h)
+        return 0 if self._h is None else self._lib.bjr_pending(self._h)
 
     def close(self, unlink=True):
         if self._h:
@@ -248,9 +296,16 @@ class ShmRingReader:
     observability.  In-flight records of the dead generation that were
     fully written are drained first; partially-written ones were never
     visible (head publishes only complete records).
+
+    ``poison=True`` (or ``BJX_SHM_POISON=1``) arms the use-after-release
+    guard on :meth:`recv_frames_view`: :meth:`release_record` releases
+    the handed-out memoryviews, so any access to a view after its ring
+    slot was freed raises ``ValueError`` instead of silently reading
+    bytes the producer may already be overwriting.
     """
 
-    def __init__(self, address, open_timeout_ms=10000, auto_reopen=True):
+    def __init__(self, address, open_timeout_ms=10000, auto_reopen=True,
+                 poison=None):
         lib = _load()
         if lib is None:
             raise RuntimeError(
@@ -260,6 +315,11 @@ class ShmRingReader:
         self._name = shm_name_from_address(address)
         self._auto_reopen = auto_reopen
         self._open_timeout_ms = open_timeout_ms
+        self._poison = (
+            os.environ.get("BJX_SHM_POISON", "") == "1"
+            if poison is None else bool(poison)
+        )
+        self._out_views = None  # views handed out by recv_frames_view
         self.reconnects = 0
         self._h = lib.bjr_open(self._name.encode(), open_timeout_ms)
         if not self._h:
@@ -356,10 +416,29 @@ class ShmRingReader:
             raise EOFError("producer closed")
         buf = (ctypes.c_char * length.value).from_address(data.value)
         mv = memoryview(buf)
-        return [mv[off : off + ln] for off, ln in _split_record(mv)]
+        views = [mv[off : off + ln] for off, ln in _split_record(mv)]
+        if self._poison:
+            self._out_views = views + [mv]
+        return views
 
     def release_record(self):
-        """Release the record handed out by :meth:`recv_frames_view`."""
+        """Release the record handed out by :meth:`recv_frames_view`.
+        With poisoning armed, the handed-out views are released too, so
+        a caller that kept one past this point gets ``ValueError`` on
+        its next access instead of bytes a later producer write may
+        already have clobbered."""
+        if self._out_views is not None:
+            views, self._out_views = self._out_views, None
+            for v in views:
+                try:
+                    v.release()
+                except BufferError:
+                    # an np.frombuffer (or similar) still exports this
+                    # view's buffer — Python cannot revoke an exported
+                    # buffer, so such a view stays un-poisoned (the
+                    # arrays built over it must be copied out before
+                    # release, same contract as the views themselves)
+                    pass
         if self._h is not None:
             self._lib.bjr_read_release(self._h)
 
@@ -389,6 +468,100 @@ def _unlink_name(name):
 def unlink_address(address):
     """Best-effort removal of a ring's shm backing file."""
     _unlink_name(shm_name_from_address(address))
+
+
+class DoorBell:
+    """A ``select()``-able wakeup line next to a shm ring: a named FIFO
+    under ``/dev/shm`` the ring WRITER dings after publishing a record,
+    so the reading process can park in one ``poll``/``select`` covering
+    its ZMQ sockets AND its shm rings instead of sleep-polling the ring
+    (the C layer's 100 µs nanosleep loop stays as the fallback when no
+    bell is attached).  FIFOs are the portable fd-shaped doorbell here —
+    unlike an eventfd they rendezvous by NAME across unrelated
+    processes, and unlike a futex they compose with ``zmq.Poller``.
+
+    Owner side (reader)::
+
+        bell = DoorBell(path, create=True)   # mkfifo + open read end
+        poller.register(bell.fd, zmq.POLLIN)
+        ...
+        bell.drain()                         # consume pending dings
+
+    Remote side (writer)::
+
+        bell = DoorBell(path)                # open write end lazily
+        ring_writer.send_frames(frames)
+        bell.ding()
+
+    A ding can never be lost between a reader's empty-ring check and its
+    park: the writer publishes the record BEFORE dinging, and the byte
+    stays readable until drained — so ``select`` returns immediately if
+    the ding already happened.  All failure modes (no reader yet, pipe
+    full, reader gone) degrade to "no wakeup", which the reader's
+    bounded poll timeout covers.
+    """
+
+    def __init__(self, path, create=False):
+        self.path = path
+        self.owner = bool(create)
+        self.fd = None
+        self._wfd = None
+        if create:
+            try:
+                os.unlink(path)  # stale bell from a crashed predecessor
+            except OSError:
+                pass
+            os.mkfifo(path, 0o600)
+            # O_RDWR instead of O_RDONLY: keeps a write end open inside
+            # this process, so writers never race ENXIO against the
+            # reader and the fd never signals EOF-readable when the
+            # last remote writer closes
+            self.fd = os.open(path, os.O_RDWR | os.O_NONBLOCK)
+
+    def ding(self):
+        """One wakeup byte, best-effort (never blocks, never raises)."""
+        try:
+            if self._wfd is None:
+                self._wfd = os.open(self.path, os.O_WRONLY | os.O_NONBLOCK)
+            os.write(self._wfd, b"\x00")
+        except OSError:
+            # ENXIO (no reader yet), EAGAIN (pipe full: the reader is
+            # awake and behind — a wakeup is already pending), or the
+            # bell vanished: the reader's poll timeout covers all three
+            if self._wfd is not None:
+                try:
+                    os.close(self._wfd)
+                except OSError:
+                    pass
+                self._wfd = None
+
+    def drain(self):
+        """Consume pending dings (owner side), returning the byte count."""
+        total = 0
+        while self.fd is not None:
+            try:
+                got = os.read(self.fd, 4096)
+            except (BlockingIOError, OSError):
+                break
+            if not got:
+                break
+            total += len(got)
+        return total
+
+    def close(self, unlink=None):
+        for attr in ("fd", "_wfd"):
+            f = getattr(self, attr)
+            if f is not None:
+                try:
+                    os.close(f)
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+        if unlink if unlink is not None else self.owner:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
 
 
 def copy_into(dst, src):

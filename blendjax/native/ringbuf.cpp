@@ -54,6 +54,8 @@ struct Handle {
   char name[256];
   int owner;          // created (vs opened)
   uint64_t last_rec;  // bytes to release after read_acquire
+  uint64_t pending_commit;  // bytes reserved by bjr_write_begin, published
+                            // by bjr_write_commit (zero-copy writer)
   uint64_t next_vanish_check_ms;  // rate-limits bjr_vanished's syscalls
                                   // across timeout-0 polls (hot rotation)
   dev_t st_dev;       // identity of the mapped shm object: a respawned
@@ -253,6 +255,34 @@ int bjr_write_v(void* handle, const void* const* bufs, const uint64_t* lens,
   }
   h->hdr->head.fetch_add(need, std::memory_order_release);
   return 0;
+}
+
+// Zero-copy writer: reserve space for one record of `len` payload bytes
+// and return a pointer to the payload start (the caller assembles the
+// record IN the arena — e.g. a columnar gather lands its batch directly
+// in shared memory, skipping the staging copy bjr_write_v would pay).
+// The record is invisible to the reader until bjr_write_commit publishes
+// it.  Returns nullptr on timeout or when the record cannot fit at all
+// (the caller distinguishes by checking the size against the capacity
+// up front).  One reservation may be outstanding per handle.
+void* bjr_write_begin(void* handle, uint64_t len, int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  const uint64_t cap = h->hdr->capacity;
+  const uint64_t need = 8 + pad8(len);
+  if (need + 8 > cap) return nullptr;
+  uint64_t pos = claim(h, need, timeout_ms);
+  if (pos == ~0ULL) return nullptr;
+  std::memcpy(h->arena + pos, &len, 8);
+  h->pending_commit = need;
+  return h->arena + pos + 8;
+}
+
+void bjr_write_commit(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  if (h->pending_commit) {
+    h->hdr->head.fetch_add(h->pending_commit, std::memory_order_release);
+    h->pending_commit = 0;
+  }
 }
 
 // Acquire the next record without copying.  *data points into the shm

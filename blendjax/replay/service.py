@@ -57,6 +57,7 @@ from collections import OrderedDict
 import numpy as np
 
 from blendjax import wire
+from blendjax.btt import shm_rpc
 from blendjax.btt.file import FileRecorder, scan_messages
 from blendjax.obs.spans import make_span, now_us
 from blendjax.replay.ring import ColumnStore
@@ -102,10 +103,18 @@ class ReplayShard:
     counters: EventCounters | None
         Sink for ``record_drops`` etc.; defaults to the process-wide
         ``fleet_counters``.
+    shm_base: str | None
+        ``/dev/shm`` name prefix for this shard's ShmRPC transport
+        (``--shm-base``): supervised fleets pass one so the PARENT can
+        sweep leaked objects after a SIGKILL (docs/transport.md).
+        Generated when None.  The transport itself only exists when
+        :func:`blendjax.btt.shm_rpc.enabled` (kill-switch
+        ``BJX_NO_SHM_RPC=1`` pins the shard to pure ZMQ).
     """
 
     def __init__(self, address, capacity, *, shard_id=0, data_dir=None,
-                 checkpoint_every=0, counters=None, context=None):
+                 checkpoint_every=0, counters=None, context=None,
+                 shm_base=None):
         import zmq
 
         self.shard_id = int(shard_id)
@@ -130,6 +139,8 @@ class ReplayShard:
             self._restore_from_disk()
             self._open_spill()
         self._reply_cache = OrderedDict()  # mid -> reply (mutating cmds)
+        self._gather_bufs = {}  # recycled gather-reply buffers (shm path)
+        self._reply_synchronous = False  # True while serving an shm request
         self._ctx = context or zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.REP)
         self._sock.setsockopt(zmq.LINGER, 0)
@@ -140,6 +151,20 @@ class ReplayShard:
         else:
             self._sock.bind(address)
             self.address = address
+        #: same-host shm transport (None when disabled/unavailable):
+        #: the ZMQ socket stays the control plane and remote fallback
+        self._shm = None
+        if shm_rpc.enabled():
+            self._shm = shm_rpc.ShmRpcServer(
+                base=shm_base or shm_rpc.new_base(f"rs{self.shard_id}"),
+                counters=self.counters, bytes_counter="replay_shm_bytes",
+                who=f"replay shard {self.shard_id}",
+            )
+
+    @property
+    def shm_endpoint(self):
+        """The advertised ``shm://`` endpoint (None on pure-ZMQ shards)."""
+        return self._shm.endpoint if self._shm is not None else None
 
     # -- durability ----------------------------------------------------------
 
@@ -293,6 +318,9 @@ class ReplayShard:
             "seq": self.seq,
             "keys": list(self.store.keys),
             "restored_from": self.restored_from,
+            # shm endpoint advertisement (None = pure-ZMQ shard); the
+            # actual upgrade negotiation rides shm_connect/shm_attach
+            "shm": self._shm.info() if self._shm is not None else None,
         }
 
     def _cmd_append(self, msg):
@@ -329,8 +357,22 @@ class ReplayShard:
     def _cmd_gather(self, msg):
         indices = np.asarray(msg["indices"], np.int64)
         keys = msg.get("keys")
-        data = self.store.gather(indices, keys=keys)
+        out = self._gather_dst if self._reply_synchronous else None
+        data = self.store.gather(indices, keys=keys, out=out)
         return {"data": data, "seq": self.seq}
+
+    def _gather_dst(self, key, shape, dtype):
+        """Recycled gather-reply buffers: fresh multi-MB batches pay
+        page faults on every RPC that a reused destination never sees.
+        Only offered on the shm reply path (``_reply_synchronous``):
+        ``send_frames`` memcpys into the ring BEFORE returning, so the
+        next request can never observe a half-overwritten buffer —
+        whereas ZMQ's ``copy=False`` send keeps the frames referenced
+        asynchronously."""
+        buf = self._gather_bufs.get(key)
+        if buf is None or buf.shape != shape or buf.dtype != dtype:
+            buf = self._gather_bufs[key] = np.empty(shape, dtype)
+        return buf
 
     def _cmd_stats(self, msg):
         return {
@@ -365,22 +407,125 @@ class ReplayShard:
 
     # -- serving -------------------------------------------------------------
 
+    def _handle_shm(self, chan, msg):
+        """One shm-delivered request: same dispatch, reply down the
+        same channel (span piggybacks, reply cache, correlation ids —
+        all transport-blind inside :meth:`handle`).  The synchronous
+        reply write unlocks the recycled gather buffers, and ``gather``
+        replies take the zero-copy fast path when they can."""
+        if msg.get("cmd") == "gather" and wire.SPAN_KEY not in msg \
+                and self._gather_into_ring(chan, msg):
+            return
+        self._reply_synchronous = True
+        try:
+            reply = self.handle(msg)
+            self._shm.send(chan, reply, raw_buffers=True)
+        finally:
+            self._reply_synchronous = False
+
+    def _gather_into_ring(self, chan, msg):
+        """Zero-copy gather reply: the columnar batch is gathered
+        DIRECTLY into the reply ring's record (``begin_send`` views)
+        instead of staged through temp arrays and memcpy'd by
+        ``send_frames`` — one copy total on the server, store ->
+        shared memory.  Returns False to defer to the generic path
+        (untraced requests only; malformed requests go generic so they
+        get their proper error replies)."""
+        from blendjax.native.ring import gather_into
+
+        cols = self.store.columns
+        try:
+            idx = np.asarray(msg["indices"], np.int64)
+        except (KeyError, TypeError, ValueError):
+            return False
+        keys = msg.get("keys") or list(cols)
+        n = int(idx.size)
+        if any(k not in cols for k in keys) or (
+            n and (idx.min() < 0 or idx.max() >= self.capacity)
+        ):
+            return False
+        t0 = time.perf_counter()
+        header = {"data": {}, "seq": self.seq}
+        mid = msg.get(wire.BTMID_KEY)
+        if mid is not None:
+            header[wire.BTMID_KEY] = mid
+        sizes = [0]
+        specs = []
+        for i, key in enumerate(keys):
+            col = cols[key]
+            row_shape = col.shape[1:]
+            row_bytes = col[0].nbytes if row_shape else col.itemsize
+            header["data"][key] = {
+                wire.ARRAY_PLACEHOLDER: i,
+                "dtype": col.dtype.str,
+                "shape": (n,) + tuple(int(d) for d in row_shape),
+            }
+            sizes.append(n * int(row_bytes))
+            specs.append((col, bool(row_shape) and row_bytes >= 1024))
+        head_bytes = wire.dumps(header)
+        sizes[0] = len(head_bytes)
+        views = self._shm.begin_send(chan, sizes)
+        if views is None:
+            return False
+        done = False
+        try:
+            views[0][:] = np.frombuffer(head_bytes, np.uint8)
+            for (col, native), dst in zip(specs, views[1:]):
+                if native:
+                    gather_into(dst, [col[i] for i in idx])
+                elif n:
+                    tmp = np.ascontiguousarray(np.take(col, idx, axis=0))
+                    dst[:] = tmp.view(np.uint8).reshape(-1)
+            done = True
+        finally:
+            if not done:
+                # a torn record with an intact header would decode as
+                # WRONG data — poison the header so the client drops
+                # the record (and its retry re-gathers), then publish:
+                # the reservation must never dangle
+                views[0][: min(8, len(head_bytes))] = 0
+            self._shm.commit_send(chan)
+        self.timer.add("shard_srv_gather", time.perf_counter() - t0,
+                       _t0=t0)
+        return True
+
     def serve_forever(self, stop_event=None, poll_ms=100):
-        """REP loop until ``stop_event`` (or :meth:`close`).  One request
-        == one reply; raw-buffer replies keep image gathers off the
-        pickle path."""
+        """Serve loop until ``stop_event`` (or :meth:`close`): the REP
+        socket (one request == one reply; raw-buffer replies keep image
+        gathers off the pickle path) and, when ShmRPC is up, every
+        attached shm channel — the transport's doorbell fd parks in the
+        same poller, so shm requests wake the loop as promptly as ZMQ
+        ones."""
         import zmq
 
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        if self._shm is not None and self._shm.fd is not None:
+            poller.register(self._shm.fd, zmq.POLLIN)
         while stop_event is None or not stop_event.is_set():
             try:
-                if not self._sock.poll(poll_ms, zmq.POLLIN):
-                    continue
-                msg = wire.recv_message(self._sock)
+                events = dict(poller.poll(poll_ms))
             except zmq.ZMQError:
                 return  # socket closed under us: clean shutdown
-            reply = self.handle(msg)
+            if self._shm is not None:
+                self._shm.pump(self._handle_shm)
+            if self._sock not in events:
+                continue
             try:
-                wire.send_message(self._sock, reply, raw_buffers=True)
+                msg, nbytes = wire.recv_message_sized(self._sock)
+            except zmq.ZMQError:
+                return
+            self.counters.incr("replay_wire_bytes", nbytes)
+            # shm control commands are transport negotiation, not
+            # storage workload: answered outside handle() (no reply
+            # cache, no stage timer, no request counters)
+            reply = shm_rpc.control_reply(self._shm, msg)
+            if reply is None:
+                reply = self.handle(msg)
+            try:
+                sent = wire.send_message(self._sock, reply,
+                                         raw_buffers=True)
+                self.counters.incr("replay_wire_bytes", sent)
             except zmq.ZMQError:
                 return
 
@@ -389,6 +534,12 @@ class ReplayShard:
             self._sock.close(0)
         except Exception:  # noqa: BLE001 - shutdown best-effort
             pass
+        if self._shm is not None:
+            try:
+                self._shm.close(unlink=True)
+            except Exception:  # noqa: BLE001
+                pass
+            self._shm = None
         if self._spill is not None:
             try:
                 self._spill.__exit__(None, None, None)
@@ -442,11 +593,15 @@ def start_shard_thread(capacity, *, shard_id=0, data_dir=None,
 class _ShardLaunchInfo:
     """Duck-typed ``launch_info`` so :class:`~blendjax.btt.watchdog.
     FleetWatchdog` / :class:`~blendjax.btt.supervise.FleetSupervisor`
-    supervise shard processes exactly like Blender producers."""
+    supervise shard processes exactly like Blender producers.  The
+    shards' ``shm://`` endpoints ride along under ``REPLAY_SHM`` (empty
+    when ShmRPC is disabled) — the launch-info half of the transport
+    advertisement; clients negotiate the actual upgrade in-band."""
 
-    def __init__(self, processes, addresses):
+    def __init__(self, processes, addresses, shm_addresses=()):
         self.processes = processes
-        self.addresses = {"REPLAY": addresses}
+        self.addresses = {"REPLAY": addresses,
+                          "REPLAY_SHM": list(shm_addresses)}
 
 
 class ShardFleet:
@@ -482,6 +637,13 @@ class ShardFleet:
         self.addresses = []
         self.launch_info = None
         self._cmds = []
+        #: per-shard /dev/shm prefixes, allocated HERE (the parent) so
+        #: teardown and the watchdog respawn path can sweep the objects
+        #: a SIGKILLed shard (and its clients) left behind
+        self.shm_bases = [
+            shm_rpc.new_base(f"sf{i}") if shm_rpc.enabled() else None
+            for i in range(self.num_shards)
+        ]
 
     def _spawn(self, cmd):
         # shared child-environment policy (see launcher.child_env:
@@ -508,16 +670,25 @@ class ShardFleet:
                     "--dir", str(self.data_dir),
                     "--checkpoint-every", str(self.checkpoint_every),
                 ]
+                if self.shm_bases[i] is not None:
+                    cmd += ["--shm-base", self.shm_bases[i]]
                 procs.append(self._spawn(cmd))
                 self.addresses.append(addr)
                 self._cmds.append(cmd)
-            self.launch_info = _ShardLaunchInfo(procs, self.addresses)
+            self.launch_info = _ShardLaunchInfo(
+                procs, self.addresses, self._shm_addresses()
+            )
             self.wait_ready(self.ready_timeout)
         except BaseException:
-            self.launch_info = _ShardLaunchInfo(procs, self.addresses)
+            self.launch_info = _ShardLaunchInfo(
+                procs, self.addresses, self._shm_addresses()
+            )
             self.close()
             raise
         return self
+
+    def _shm_addresses(self):
+        return [f"shm://{b}" for b in self.shm_bases if b is not None]
 
     def wait_ready(self, timeout=30.0):
         """Block until every shard answers ``hello`` — the deterministic
@@ -546,7 +717,12 @@ class ShardFleet:
     def respawn(self, idx):
         """Relaunch shard ``idx`` with its original command line (the
         watchdog's contract).  The fresh process restores checkpoint +
-        spill tail from ``data_dir`` before serving."""
+        spill tail from ``data_dir`` before serving.  The dead
+        incarnation's ``/dev/shm`` objects (rings, bells — a SIGKILL
+        runs no cleanup) are swept FIRST, so generations cannot pile up
+        across a chaos run's kill/respawn cycles."""
+        if self.shm_bases[idx] is not None:
+            shm_rpc.unlink_base(self.shm_bases[idx])
         proc = self._spawn(self._cmds[idx])
         self.launch_info.processes[idx] = proc
         return proc
@@ -568,6 +744,11 @@ class ShardFleet:
                     p.kill()
                 except Exception:  # noqa: BLE001
                     pass
+        # the processes are down: sweep every shm object of the fleet
+        # (the registered-names half of the no-leaked-/dev/shm contract)
+        for base in self.shm_bases:
+            if base is not None:
+                shm_rpc.unlink_base(base)
 
     def __exit__(self, *exc):
         self.close()
@@ -585,11 +766,16 @@ def main(argv=None):
     ap.add_argument("--dir", default=None,
                     help="durability root (checkpoints + .btr spill)")
     ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--shm-base", default=None,
+                    help="/dev/shm name prefix for the ShmRPC transport "
+                         "(supervising parents pass one so they can "
+                         "sweep a SIGKILLed shard's objects)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     shard = ReplayShard(
         args.address, args.capacity, shard_id=args.shard_id,
         data_dir=args.dir, checkpoint_every=args.checkpoint_every,
+        shm_base=args.shm_base,
     )
     stop = threading.Event()
 

@@ -38,6 +38,7 @@ heal thread calls :meth:`ShardedReplay.probe`.
 from __future__ import annotations
 
 import logging
+import os
 import socket as _socket
 import threading
 import time
@@ -80,20 +81,23 @@ class ShardRPCError(TimeoutError):
 
 
 class ShardClient:
-    """DEALER channel to one replay shard with exactly-once retries.
+    """RPC channel to one replay shard with exactly-once retries.
 
     Every request is stamped with a fresh ``wire.BTMID_KEY``; a
     fault-policy retry re-sends the SAME id, and replies whose id does
     not match the outstanding request are dropped as stale (a late
     first-attempt reply after a retry, or a dead incarnation's
     leftovers after :meth:`reset_channel`).
+
+    The wire itself is a :class:`~blendjax.btt.transport.RpcChannel`:
+    ZMQ DEALER always (control plane + remote fallback), transparently
+    upgraded to the ShmRPC ring pair for a same-host shard
+    (docs/transport.md).  ``shm=False`` pins the client to ZMQ.
     """
 
     def __init__(self, address, shard_id=0, *, fault_policy=None,
                  counters=None, timeoutms=5000, context=None,
-                 span_recorder=None):
-        import zmq
-
+                 span_recorder=None, shm="auto", shm_chaos=None):
         self.address = address
         self.shard_id = int(shard_id)
         self.policy = fault_policy or FaultPolicy()
@@ -103,26 +107,38 @@ class ShardClient:
         #: cross-process span sink (None = tracing off): client-side RPC
         #: spans plus the shard's piggybacked server-side spans
         self.spans = span_recorder
-        self._ctx = context or zmq.Context.instance()
-        self._sock = None
+        self._ctx = context
+        self._shm_mode = shm
+        self._shm_chaos = shm_chaos
+        self._chan = None
 
-    def _socket(self):
-        import zmq
+    def _channel(self):
+        if self._chan is None:
+            from blendjax.btt.transport import RpcChannel
 
-        if self._sock is None:
-            s = self._ctx.socket(zmq.DEALER)
-            s.setsockopt(zmq.LINGER, 0)
-            s.connect(self.address)
-            self._sock = s
-        return self._sock
+            self._chan = RpcChannel(
+                self.address, context=self._ctx, shm=self._shm_mode,
+                shm_chaos=self._shm_chaos,
+                # zero-copy reply views: every ShardClient reply is
+                # consumed before the next RPC (gather scatters into
+                # the batch, read_row copies, hellos carry no arrays)
+                view_replies=True,
+                name=f"replay-shard-{self.shard_id}",
+            )
+        return self._chan
+
+    @property
+    def transport(self):
+        """The wire the next RPC rides: ``"shm"`` or ``"tcp"``."""
+        return self._chan.transport if self._chan is not None else "tcp"
 
     def reset_channel(self):
-        """Drop the DEALER socket so the next RPC dials fresh — replies
-        a dead shard incarnation still manages to emit die with the old
-        socket instead of confusing the re-admitted one."""
-        if self._sock is not None:
-            self._sock.close(0)
-            self._sock = None
+        """Drop the channel (DEALER socket AND any shm ring pair) so
+        the next RPC dials fresh — replies a dead shard incarnation
+        still manages to emit die with the old channel instead of
+        confusing the re-admitted one."""
+        if self._chan is not None:
+            self._chan.reset()
 
     close = reset_channel
 
@@ -137,7 +153,7 @@ class ShardClient:
         msg = dict(payload or {})
         msg["cmd"] = cmd
         return exactly_once_rpc(
-            self._socket, msg,
+            self._channel, msg,
             policy=self.policy, state=self.state,
             counters=self.counters,
             wait_ms=(self.timeoutms if timeout_ms is None
@@ -274,25 +290,55 @@ class _ShardedStore:
         t0 = time.perf_counter()
         try:
             shard_of = idx // o.shard_capacity
-            for s in np.unique(shard_of):
+            shards = np.unique(shard_of)
+            jobs = []
+            for s in shards:
                 pos = np.flatnonzero(shard_of == s)
-                local = idx[pos] % o.shard_capacity
-                try:
-                    reply = o.clients[int(s)].rpc(
-                        "gather",
-                        {"indices": local.tolist(),
-                         "keys": list(selected)},
-                        raw_buffers=True,
-                    )
-                except ShardRPCError as exc:
-                    o._quarantine_locked(int(s), reason=str(exc))
-                    raise
-                data = reply["data"]
-                for key in selected:
-                    batch[key][pos] = data[key]
+                jobs.append((int(s), pos, idx[pos] % o.shard_capacity))
+            if len(jobs) > 1 and o._gather_pool is not None:
+                # one RPC per shard, in flight CONCURRENTLY: the
+                # shards' gathers/ring writes overlap each other (and
+                # this thread's scatters) instead of serializing one
+                # round trip at a time — most of the wire tax a
+                # multi-shard batch still pays after ShmRPC is latency,
+                # not bytes
+                results = list(o._gather_pool.map(
+                    lambda job: self._fetch_shard(job, selected, batch),
+                    jobs,
+                ))
+            else:
+                results = [self._fetch_shard(job, selected, batch)
+                           for job in jobs]
+            for s, exc in results:
+                if exc is not None:
+                    o._quarantine_locked(s, reason=str(exc))
+            for s, exc in results:
+                if exc is not None:
+                    raise exc
         finally:
             o.timer.add("shard_gather", time.perf_counter() - t0, _t0=t0)
         return batch
+
+    def _fetch_shard(self, job, selected, batch):
+        """One shard's slice of a gather: RPC + scatter into the batch
+        destinations (disjoint row sets, so concurrent workers never
+        overlap).  Returns ``(shard, ShardRPCError | None)`` — the
+        quarantine decision stays with the calling thread, which holds
+        the buffer lock."""
+        s, pos, local = job
+        o = self.owner
+        try:
+            reply = o.clients[s].rpc(
+                "gather",
+                {"indices": local.tolist(), "keys": list(selected)},
+                raw_buffers=True,
+            )
+        except ShardRPCError as exc:
+            return s, exc
+        data = reply["data"]
+        for key in selected:
+            batch[key][pos] = data[key]
+        return s, None
 
     # -- checkpoint surface (storage rides on the shards) --------------------
 
@@ -332,7 +378,8 @@ class ShardedReplay(ReplayBuffer):
                  beta=0.4, eps=1e-3, counters=None, timer=None,
                  fault_policy=None, timeoutms=5000, name=None,
                  shard_capacity=None, allow_dead=False, context=None,
-                 trace=False, span_recorder=None):
+                 trace=False, span_recorder=None, shm="auto",
+                 parallel_gather=None):
         if not shards:
             raise ValueError("ShardedReplay needs at least one shard")
         counters = counters if counters is not None else fleet_counters
@@ -358,7 +405,7 @@ class ShardedReplay(ReplayBuffer):
                 clients.append(ShardClient(
                     s, i, fault_policy=policy, counters=counters,
                     timeoutms=timeoutms, context=context,
-                    span_recorder=self.spans,
+                    span_recorder=self.spans, shm=shm,
                 ))
         dead_at_init = []
         hellos = []
@@ -400,6 +447,22 @@ class ShardedReplay(ReplayBuffer):
         )
         self.clients = clients
         self.store = _ShardedStore(self)
+        #: worker pool for concurrent per-shard gather RPCs (None =
+        #: sequential): on by default on multi-core hosts with multiple
+        #: shards — the shards' server-side gathers and ring writes
+        #: overlap instead of serializing one round trip at a time
+        if parallel_gather is None:
+            parallel_gather = (
+                self.num_shards > 1 and (os.cpu_count() or 1) > 1
+            )
+        self._gather_pool = None
+        if parallel_gather and self.num_shards > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._gather_pool = ThreadPoolExecutor(
+                max_workers=min(self.num_shards, 8),
+                thread_name_prefix="bjx-shard-gather",
+            )
         #: per-shard rows durably acked (the client half of the
         #: crash-exact contract: re-admission verifies the shard's seq
         #: cursor against this)
@@ -862,5 +925,8 @@ class ShardedReplay(ReplayBuffer):
         return st
 
     def close(self):
+        if self._gather_pool is not None:
+            self._gather_pool.shutdown(wait=False)
+            self._gather_pool = None
         for c in self.clients:
             c.close()

@@ -48,9 +48,7 @@ class ServeClient:
 
     def __init__(self, address, *, fault_policy=None, counters=None,
                  timeoutms=5000, context=None, span_recorder=None,
-                 name="serve", model=None):
-        import zmq
-
+                 name="serve", model=None, shm="auto", shm_chaos=None):
         self.address = address
         self.name = name
         self.policy = fault_policy or FaultPolicy()
@@ -70,25 +68,32 @@ class ServeClient:
         #: cross-process span sink (None = tracing off): client RPC
         #: spans plus the server's piggybacked serve-side spans
         self.spans = span_recorder
-        self._ctx = context or zmq.Context.instance()
-        self._sock = None
+        self._ctx = context
+        self._shm_mode = shm
+        self._shm_chaos = shm_chaos
+        self._chan = None
 
-    def _socket(self):
-        import zmq
+    def _channel(self):
+        if self._chan is None:
+            from blendjax.btt.transport import RpcChannel
 
-        if self._sock is None:
-            s = self._ctx.socket(zmq.DEALER)
-            s.setsockopt(zmq.LINGER, 0)
-            s.connect(self.address)
-            self._sock = s
-        return self._sock
+            self._chan = RpcChannel(
+                self.address, context=self._ctx, shm=self._shm_mode,
+                shm_chaos=self._shm_chaos, name=self.name,
+            )
+        return self._chan
+
+    @property
+    def transport(self):
+        """The wire the next RPC rides: ``"shm"`` or ``"tcp"``."""
+        return self._chan.transport if self._chan is not None else "tcp"
 
     def reset_channel(self):
-        """Drop the DEALER socket so the next RPC dials fresh (stale
-        replies of a dead server incarnation die with the old one)."""
-        if self._sock is not None:
-            self._sock.close(0)
-            self._sock = None
+        """Drop the channel (DEALER socket AND any shm ring pair) so
+        the next RPC dials fresh (stale replies of a dead server
+        incarnation die with the old one)."""
+        if self._chan is not None:
+            self._chan.reset()
 
     close = reset_channel
 
@@ -109,7 +114,7 @@ class ServeClient:
         via = (f", last replica {self.replica}"
                if self.replica is not None else "")
         reply = exactly_once_rpc(
-            self._socket, msg,
+            self._channel, msg,
             policy=self.policy, state=self.state,
             counters=self.counters,
             wait_ms=(self.timeoutms if timeout_ms is None

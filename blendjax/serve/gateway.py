@@ -79,6 +79,7 @@ import time
 from collections import OrderedDict, deque
 
 from blendjax import wire
+from blendjax.btt import shm_rpc
 from blendjax.obs.histogram import LatencyHistogram
 from blendjax.obs.spans import make_span, now_us
 from blendjax.serve.server import (
@@ -108,7 +109,7 @@ class _Replica:
         "id", "address", "sock", "healthy", "draining", "models",
         "queued", "live", "p99_ms", "pending_live", "last_ok",
         "incarnation", "scrape_mid", "scrape_sent", "next_scrape", "pid",
-        "caps",
+        "caps", "shm", "shm_state", "shm_next_try",
     )
 
     def __init__(self, rid, address, sock, now):
@@ -131,6 +132,12 @@ class _Replica:
         self.next_scrape = 0.0  # scrape immediately on loop start
         self.pid = None
         self.caps = None  # PR-10 capability fields from the scrape
+        #: backend ShmRPC channel (None = ZMQ): negotiated through the
+        #: scrape cycle once the replica proves alive, torn down on
+        #: quarantine, re-negotiated after respawn
+        self.shm = None
+        self.shm_state = "idle"  # idle | pending | active | off
+        self.shm_next_try = 0.0
 
     def hosts(self, model):
         return model is None or self.models is None or model in self.models
@@ -217,7 +224,8 @@ class ServeGateway:
     def __init__(self, address, replicas, *, scrape_interval_s=0.25,
                  quarantine_after_s=None, lease_ttl_s=600.0,
                  counters=None, timer=None,
-                 reply_cache_depth=REPLY_CACHE_DEPTH, context=None):
+                 reply_cache_depth=REPLY_CACHE_DEPTH, context=None,
+                 shm_base=None):
         import zmq
 
         if not replicas:
@@ -261,6 +269,18 @@ class ServeGateway:
         self._reply_cache_depth = int(reply_cache_depth)
         #: watchdog notices (thread-safe appends), applied on the loop
         self._notices = deque()
+        #: front-side ShmRPC transport (clients upgrade onto it exactly
+        #: as against a bare server) — its bell doubles as the shared
+        #: reply-wake fd for the BACKEND shm channels, so one poller
+        #: entry covers every ring this process reads
+        self._shm_front = None
+        if shm_rpc.enabled():
+            self._shm_front = shm_rpc.ShmRpcServer(
+                base=shm_base or shm_rpc.new_base("gw"),
+                counters=self.counters, who="gateway",
+            )
+        #: in-flight backend upgrade handshakes: mid -> (phase, rid)
+        self._shm_connects = {}
 
     # -- admin (callable from any thread; applied under the GIL) -------------
 
@@ -311,9 +331,33 @@ class ServeGateway:
                 (lease.rid, lease.incarnation, lease.episode), None
             )
 
+    def _demote_backend(self, rep, reason, backoff_s=2.0):
+        """Drop a replica's shm channel and fall back to its DEALER
+        socket (re-negotiated through the scrape cycle)."""
+        if rep.shm is not None:
+            try:
+                rep.shm.close(unlink=True)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            rep.shm = None
+            logger.warning("gateway: replica %s shm channel demoted "
+                           "(%s)", rep.id, reason)
+        if rep.shm_state != "off":
+            rep.shm_state = "idle"
+            rep.shm_next_try = time.monotonic() + backoff_s
+        for mid in [m for m, entry in self._shm_connects.items()
+                    if entry[1] == rep.id]:
+            entry = self._shm_connects.pop(mid)
+            if len(entry) > 2 and entry[2] is not None:
+                try:
+                    entry[2].close(unlink=True)
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _quarantine(self, rep):
         if not rep.healthy:
             return
+        self._demote_backend(rep, "replica quarantined", backoff_s=0.5)
         rep.healthy = False
         rep.incarnation += 1
         rep.pending_live = 0
@@ -408,6 +452,79 @@ class ServeGateway:
                 ).percentiles()["p99_ms"]
             except Exception:  # noqa: BLE001 - scrape must not kill routing
                 pass
+        # the replica just proved alive: (re-)negotiate its shm channel
+        self._maybe_upgrade_backend(rep)
+
+    # -- backend shm upgrade (rides the scrape cycle, fully async) -----------
+
+    def _maybe_upgrade_backend(self, rep):
+        import zmq
+
+        if (self._shm_front is None or rep.shm is not None
+                or rep.shm_state in ("pending", "off")
+                or time.monotonic() < rep.shm_next_try):
+            return
+        msg = {"cmd": "shm_connect", "host": shm_rpc.host_token()}
+        mid = wire.stamp_message_id(msg)
+        try:
+            wire.send_message_dealer(rep.sock, msg, flags=zmq.DONTWAIT)
+        except zmq.ZMQError:
+            return
+        rep.shm_state = "pending"
+        self._shm_connects[mid] = ("connect", rep.id, None)
+
+    def _handle_backend_upgrade(self, rep, phase, chan, reply):
+        """One step of the async backend handshake (connect -> attach
+        -> open), driven entirely by replies arriving on the replica's
+        DEALER socket — the gateway loop never blocks on it."""
+        import zmq
+
+        def fail(permanent=False, close_chan=None):
+            if close_chan is not None:
+                try:
+                    close_chan.close(unlink=True)
+                except Exception:  # noqa: BLE001
+                    pass
+            rep.shm_state = "off" if permanent else "idle"
+            rep.shm_next_try = time.monotonic() + 5.0
+
+        if not rep.healthy:
+            return fail(close_chan=chan)
+        if phase == "connect":
+            if "error" in reply or "shm_channel" not in reply:
+                # a considered refusal (kill-switch, host mismatch,
+                # pre-ShmRPC replica): permanent for this incarnation
+                logger.info("gateway: replica %s refused shm (%s)",
+                            rep.id, reply.get("error", "no channel"))
+                return fail(permanent=True)
+            try:
+                new_chan = shm_rpc.ShmClientChannel(
+                    reply["shm_channel"], reply["shm_bell"],
+                    bell=self._shm_front.bell,
+                )
+            except Exception:  # noqa: BLE001 - degrade, never fail
+                return fail()
+            msg = {"cmd": "shm_attach", "channel": new_chan.name,
+                   "bell": new_chan.bell_path}
+            mid = wire.stamp_message_id(msg)
+            try:
+                wire.send_message_dealer(rep.sock, msg,
+                                         flags=zmq.DONTWAIT)
+            except zmq.ZMQError:
+                return fail(close_chan=new_chan)
+            self._shm_connects[mid] = ("attach", rep.id, new_chan)
+            return
+        # phase == "attach"
+        if "error" in reply:
+            return fail(close_chan=chan)
+        try:
+            chan.finish(open_timeout_ms=1000)
+        except Exception:  # noqa: BLE001
+            return fail(close_chan=chan)
+        rep.shm = chan
+        rep.shm_state = "active"
+        logger.info("gateway: replica %s upgraded to shm channel %s",
+                    rep.id, chan.name)
 
     # -- gateway-level commands ----------------------------------------------
 
@@ -430,6 +547,8 @@ class ServeGateway:
             "replicas": {r.id: r.snapshot()
                          for r in self._replicas.values()},
             "models": sorted(models),
+            "shm": (self._shm_front.info()
+                    if self._shm_front is not None else None),
             "pid": os.getpid(),
         })
         return out
@@ -517,6 +636,23 @@ class ServeGateway:
             while len(self._routes) > ROUTE_CACHE_DEPTH:
                 self._routes.popitem(last=False)
         t0 = time.perf_counter()
+        if rep.shm is not None:
+            # the upgraded wire first; a full ring falls through to the
+            # DEALER socket (same replica, same mid — the wires differ,
+            # the discipline does not), a dead ring demotes
+            try:
+                frames = wire.encode(msg, raw_buffers=True)
+                if rep.shm.send(frames, timeout_ms=0):
+                    self.timer.add("gw_forward",
+                                   time.perf_counter() - t0)
+                    self.counters.incr("gateway_routed")
+                    return
+            except ValueError:
+                pass  # oversized for the ring: this one rides ZMQ
+            except (OSError, EOFError) as exc:
+                self._demote_backend(
+                    rep, f"{type(exc).__name__}: {exc}"
+                )
         try:
             wire.send_message_dealer(rep.sock, msg, raw_buffers=True,
                                      flags=zmq.DONTWAIT)
@@ -584,6 +720,15 @@ class ServeGateway:
     def _send_client(self, ident, reply):
         import zmq
 
+        if ident is not None and getattr(ident, "shm_channel", False):
+            # the request arrived on the shm front: the reply rides the
+            # same channel (a dead one is dropped; the client demotes
+            # and its same-mid retry re-fetches from the reply cache)
+            if self._shm_front is not None and self._shm_front.send(
+                ident, reply, raw_buffers=True
+            ):
+                self.counters.incr("gateway_replies")
+            return
         try:
             wire.send_message_router(self._front, ident, reply,
                                      raw_buffers=True)
@@ -730,6 +875,11 @@ class ServeGateway:
         # (re-admission itself stays scrape-driven)
         rep.last_ok = time.monotonic()
         mid = reply.get(wire.BTMID_KEY)
+        if mid is not None and mid in self._shm_connects:
+            phase, rid, chan = self._shm_connects.pop(mid)
+            self._handle_backend_upgrade(self._replicas[rid], phase,
+                                         chan, reply)
+            return
         if mid is not None and mid in self._scrapes:
             rid = self._scrapes.pop(mid)
             self._ingest_scrape(self._replicas[rid], reply)
@@ -813,12 +963,49 @@ class ServeGateway:
     def _drain_front(self):
         import zmq
 
+        def handle(out):
+            ident, msg = out
+            reply = shm_rpc.control_reply(self._shm_front, msg)
+            if reply is not None:
+                # transport negotiation with THIS gateway — answered
+                # here (uncounted), never forwarded to the fleet
+                try:
+                    wire.send_message_router(self._front, ident, reply)
+                except zmq.ZMQError:
+                    pass
+                return
+            self._handle_client(ident, msg)
+
         drain_socket(
             lambda: wire.recv_message_router(self._front,
                                              flags=zmq.NOBLOCK),
-            lambda out: self._handle_client(*out),
+            handle,
             self.counters, "gateway", "client request",
         )
+
+    def _drain_front_shm(self):
+        if self._shm_front is None:
+            return
+
+        def handle(chan, msg):
+            reply = shm_rpc.control_reply(self._shm_front, msg)
+            if reply is not None:
+                self._shm_front.send(chan, reply)
+                return
+            self._handle_client(chan, msg)
+
+        self._shm_front.pump(handle)
+
+    def _drain_replica_shm(self, rep):
+        while rep.shm is not None:
+            try:
+                reply = rep.shm.try_recv()
+            except (OSError, EOFError) as exc:
+                self._demote_backend(rep, f"{type(exc).__name__}: {exc}")
+                return
+            if reply is None:
+                return
+            self._handle_replica_reply(rep, reply)
 
     def _drain_replica(self, rep):
         import zmq
@@ -835,6 +1022,11 @@ class ServeGateway:
 
         poller = zmq.Poller()
         poller.register(self._front, zmq.POLLIN)
+        if self._shm_front is not None and self._shm_front.fd is not None:
+            # ONE fd wakes the loop for the whole shm side: front
+            # channels ding it directly, and the backend channels were
+            # attached with it as their reply bell
+            poller.register(self._shm_front.fd, zmq.POLLIN)
         for rep in self._replicas.values():
             poller.register(rep.sock, zmq.POLLIN)
         while stop_event is None or not stop_event.is_set():
@@ -844,9 +1036,11 @@ class ServeGateway:
                 events = dict(poller.poll(poll_ms))
                 if self._front in events:
                     self._drain_front()
+                self._drain_front_shm()
                 for rep in self._replicas.values():
                     if rep.sock in events:
                         self._drain_replica(rep)
+                    self._drain_replica_shm(rep)
             except zmq.ZMQError:
                 return  # a socket closed under us: clean shutdown
 
@@ -856,10 +1050,17 @@ class ServeGateway:
         except Exception:  # noqa: BLE001 - shutdown best-effort
             pass
         for rep in self._replicas.values():
+            self._demote_backend(rep, "gateway shutdown")
             try:
                 rep.sock.close(0)
             except Exception:  # noqa: BLE001
                 pass
+        if self._shm_front is not None:
+            try:
+                self._shm_front.close(unlink=True)
+            except Exception:  # noqa: BLE001
+                pass
+            self._shm_front = None
 
 
 class _LocalGatewayHandle:

@@ -68,6 +68,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from blendjax import wire
+from blendjax.btt import shm_rpc
 from blendjax.obs.spans import make_span, now_us
 from blendjax.utils.timing import StageTimer, fleet_counters
 
@@ -462,7 +463,7 @@ class PolicyServer:
     def __init__(self, address, model, *, serial=False, tick_ms=2.0,
                  max_batch=64, buckets=None, slot_ttl_s=None,
                  reply_cache_depth=REPLY_CACHE_DEPTH, counters=None,
-                 timer=None, context=None):
+                 timer=None, context=None, shm_base=None):
         import zmq
 
         if isinstance(model, dict):
@@ -521,6 +522,27 @@ class PolicyServer:
         else:
             self._sock.bind(address)
             self.address = address
+        #: same-host ShmRPC transport (None when disabled): serves the
+        #: SAME admission queue/slot pools — a request is a request
+        #: whichever wire delivered it; the ZMQ socket stays the
+        #: control plane and the remote-client path
+        self._shm = None
+        if shm_rpc.enabled():
+            self._shm = shm_rpc.ShmRpcServer(
+                base=shm_base or shm_rpc.new_base("ps"),
+                counters=self.counters, bytes_counter="serve_shm_bytes",
+                who="policy server",
+            )
+        self._poller = zmq.Poller()
+        self._poller.register(self._sock, zmq.POLLIN)
+        if self._shm is not None and self._shm.fd is not None:
+            self._poller.register(self._shm.fd, zmq.POLLIN)
+
+    @property
+    def shm_endpoint(self):
+        """The advertised ``shm://`` endpoint (None on pure-ZMQ
+        servers)."""
+        return self._shm.endpoint if self._shm is not None else None
 
     @property
     def model(self):
@@ -611,6 +633,7 @@ class PolicyServer:
                 }
                 for s in self._models.values()
             },
+            "shm": self._shm.info() if self._shm is not None else None,
             "pid": os.getpid(),
         }
 
@@ -792,12 +815,24 @@ class PolicyServer:
     def _send(self, ident, reply):
         import zmq
 
+        if ident is not None and getattr(ident, "shm_channel", False):
+            # the request arrived over shm: the reply goes back down
+            # the same channel (a dead/full channel is dropped — the
+            # client demotes to ZMQ and its same-mid retry re-fetches
+            # from the reply cache)
+            if self._shm is not None and self._shm.send(
+                ident, reply, raw_buffers=True
+            ):
+                self.counters.incr("serve_replies")
+            return
         try:
             if self.serial:
-                wire.send_message(self._sock, reply, raw_buffers=True)
-            else:
-                wire.send_message_router(self._sock, ident, reply,
+                sent = wire.send_message(self._sock, reply,
                                          raw_buffers=True)
+            else:
+                sent = wire.send_message_router(self._sock, ident, reply,
+                                                raw_buffers=True)
+            self.counters.incr("serve_wire_bytes", sent)
             self.counters.incr("serve_replies")
         except zmq.ZMQError:
             pass  # client gone; its retry will re-dial
@@ -994,12 +1029,44 @@ class PolicyServer:
         """Admit every request currently sitting on the socket."""
         import zmq
 
+        def handle(out):
+            ident, msg, nbytes = out
+            self.counters.incr("serve_wire_bytes", nbytes)
+            reply = shm_rpc.control_reply(self._shm, msg)
+            if reply is not None:
+                # transport negotiation, not workload: answered outside
+                # the request/reply counters and the reply cache
+                try:
+                    wire.send_message_router(self._sock, ident, reply)
+                except zmq.ZMQError:
+                    pass
+                return
+            self._admit(ident, msg)
+
         drain_socket(
-            lambda: wire.recv_message_router(self._sock,
-                                             flags=zmq.NOBLOCK),
-            lambda out: self._admit(*out),
+            lambda: wire.recv_message_router_sized(self._sock,
+                                                   flags=zmq.NOBLOCK),
+            handle,
             self.counters, "policy server", "request",
         )
+
+    def _handle_shm_msg(self, chan, msg):
+        reply = shm_rpc.control_reply(self._shm, msg)
+        if reply is not None:
+            self._shm.send(chan, reply)
+            return
+        self._admit(chan, msg)
+        if self.serial:
+            # serial semantics are per-REQUEST (the batching baseline):
+            # tick immediately so co-pumped shm requests never batch
+            while self._queue:
+                self._tick()
+
+    def _drain_shm(self):
+        """Admit every request pending on the shm channels (the channel
+        object rides as the request's reply ident)."""
+        if self._shm is not None:
+            self._shm.pump(self._handle_shm_msg)
 
     def serve_forever(self, stop_event=None, poll_ms=50):
         import zmq
@@ -1010,8 +1077,9 @@ class PolicyServer:
         while stop_event is None or not stop_event.is_set():
             try:
                 if not self._queue:
-                    self._sock.poll(poll_ms, zmq.POLLIN)
+                    self._poller.poll(poll_ms)
                     self._drain()
+                    self._drain_shm()
                     if not self._queue:
                         continue
                 # admission window: work is queued — wait up to tick_ms
@@ -1026,10 +1094,10 @@ class PolicyServer:
                     rem_ms = (t_end - time.perf_counter()) * 1e3
                     if rem_ms <= 0:
                         break
-                    if not self._sock.poll(max(1, int(rem_ms)),
-                                           zmq.POLLIN):
+                    if not self._poller.poll(max(1, int(rem_ms))):
                         break  # window elapsed with nothing new
                     self._drain()
+                    self._drain_shm()
             except zmq.ZMQError:
                 return  # socket closed under us: clean shutdown
             if self._queue:
@@ -1040,15 +1108,21 @@ class PolicyServer:
                     pass
 
     def _serve_serial(self, stop_event, poll_ms):
-        """The REP baseline: one request, one (batch-1) reply."""
+        """The REP baseline: one request, one (batch-1) reply.  shm
+        channels are served from the same loop (their replies ride
+        their own rings, so the REP alternation only governs the ZMQ
+        socket)."""
         import zmq
 
         while stop_event is None or not stop_event.is_set():
             try:
-                if not self._sock.poll(poll_ms, zmq.POLLIN):
+                events = dict(self._poller.poll(poll_ms))
+                self._drain_shm()  # ticks per message (serial handler)
+                if self._sock not in events:
                     continue
                 try:
-                    msg = wire.recv_message(self._sock)
+                    msg, nbytes = wire.recv_message_sized(self._sock)
+                    self.counters.incr("serve_wire_bytes", nbytes)
                 except zmq.ZMQError:
                     return
                 except Exception as exc:  # noqa: BLE001 - see _drain
@@ -1066,6 +1140,13 @@ class PolicyServer:
                     continue
             except zmq.ZMQError:
                 return
+            reply = shm_rpc.control_reply(self._shm, msg)
+            if reply is not None:
+                try:
+                    wire.send_message(self._sock, reply)
+                except zmq.ZMQError:
+                    return
+                continue
             self._admit(None, msg)
             while self._queue:
                 self._tick()
@@ -1075,6 +1156,12 @@ class PolicyServer:
             self._sock.close(0)
         except Exception:  # noqa: BLE001 - shutdown best-effort
             pass
+        if self._shm is not None:
+            try:
+                self._shm.close(unlink=True)
+            except Exception:  # noqa: BLE001
+                pass
+            self._shm = None
 
 
 # ---------------------------------------------------------------------------
@@ -1151,6 +1238,11 @@ class ServerProcess:
         self.address = address or f"tcp://127.0.0.1:{free_port()}"
         self.python = python or sys.executable
         self.ready_timeout = ready_timeout
+        #: the server's /dev/shm prefix, allocated HERE (the parent) so
+        #: teardown and the watchdog respawn path can sweep whatever a
+        #: SIGKILLed server (and its clients) left behind
+        self.shm_base = shm_rpc.new_base("sp") if shm_rpc.enabled() \
+            else None
         self._cmd = [
             self.python, "-m", "blendjax.serve.server",
             "--address", self.address,
@@ -1163,6 +1255,8 @@ class ServerProcess:
             "--tick-ms", str(tick_ms),
             "--max-batch", str(max_batch),
         ]
+        if self.shm_base is not None:
+            self._cmd += ["--shm-base", self.shm_base]
         if work_us:
             self._cmd += ["--work-us", str(work_us)]
         if window is not None:
@@ -1220,7 +1314,10 @@ class ServerProcess:
 
     def respawn(self, idx=0):
         """Relaunch with the original command line (the watchdog's
-        contract)."""
+        contract).  The dead incarnation's ``/dev/shm`` objects are
+        swept first — a SIGKILL runs no cleanup."""
+        if self.shm_base is not None:
+            shm_rpc.unlink_base(self.shm_base)
         proc = self._spawn()
         self.launch_info.processes[idx] = proc
         return proc
@@ -1242,6 +1339,8 @@ class ServerProcess:
                     p.kill()
                 except Exception:  # noqa: BLE001
                     pass
+        if self.shm_base is not None:
+            shm_rpc.unlink_base(self.shm_base)
 
     def __exit__(self, *exc):
         self.close()
@@ -1375,6 +1474,10 @@ def main(argv=None):
     ap.add_argument("--work-us", type=float, default=0,
                     help="linear model only: sleep-based per-row "
                          "compute stand-in (gateway scale-out bench)")
+    ap.add_argument("--shm-base", default=None,
+                    help="/dev/shm name prefix for the ShmRPC transport "
+                         "(supervising parents pass one so they can "
+                         "sweep a SIGKILLed server's objects)")
     ap.add_argument(
         "--extra-model", action="append", default=[],
         metavar="NAME=KIND",
@@ -1400,6 +1503,7 @@ def main(argv=None):
     server = PolicyServer(
         args.address, model, serial=args.serial,
         tick_ms=args.tick_ms, max_batch=args.max_batch,
+        shm_base=args.shm_base,
     )
     stop = threading.Event()
 

@@ -109,13 +109,19 @@ FLEET_EVENTS = (
 #: client-side (flushed on re-admission, never lost);
 #: ``replay_shard_lost`` — rows a restarted shard could not account for
 #: (it restored an older state than the client acked); their slots are
-#: invalidated instead of serving wrong rows.
+#: invalidated instead of serving wrong rows;
+#: per-request wire-bytes accounting (docs/transport.md): a shard
+#: counts every RPC payload byte it moves, split by wire —
+#: ``replay_wire_bytes`` over the ZMQ socket, ``replay_shm_bytes``
+#: through the ShmRPC rings — so the shm-vs-tcp byte saving is
+#: observable in a telemetry scrape, not just inferred from latency.
 REPLAY_EVENTS = (
     "replay_appends", "replay_overwrites", "replay_excluded",
     "replay_samples", "replay_sample_waits", "replay_priority_updates",
     "replay_sample_skips",
     "replay_shard_quarantined", "replay_shard_readmissions",
     "replay_shard_journal", "replay_shard_lost",
+    "replay_wire_bytes", "replay_shm_bytes",
 )
 
 #: Canonical policy-serving event names (see docs/serving.md).  Same
@@ -140,12 +146,17 @@ REPLAY_EVENTS = (
 #: retry;
 #: ``serve_prefills`` — episodes admitted WITH a T-step observation
 #: prefix replayed in one teacher-forced batched pass (docs/serving.md
-#: "Batched prefill admission") instead of T serial decode steps.
+#: "Batched prefill admission") instead of T serial decode steps;
+#: per-request wire-bytes accounting (docs/transport.md): the server
+#: counts every request/reply payload byte it moves, split by wire —
+#: ``serve_wire_bytes`` over the ZMQ socket, ``serve_shm_bytes``
+#: through the ShmRPC rings.
 SERVE_EVENTS = (
     "serve_requests", "serve_replies", "serve_batches",
     "serve_batch_pad", "serve_cache_hits", "serve_dup_inflight",
     "serve_resets", "serve_closes", "serve_evictions",
     "serve_slot_denied", "serve_errors", "serve_prefills",
+    "serve_wire_bytes", "serve_shm_bytes",
 )
 
 #: Canonical serve-gateway event names (see docs/serving.md

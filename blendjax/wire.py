@@ -175,17 +175,39 @@ def decode(frames) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def frames_nbytes(frames) -> int:
+    """Total payload bytes of a frame list — the transport-neutral
+    wire-bytes unit behind the ``*_wire_bytes``/``*_shm_bytes``
+    counters (what :func:`encode` produced, not what any particular
+    wire wrapped around it)."""
+    total = 0
+    for f in frames:
+        total += f.nbytes if hasattr(f, "nbytes") else len(f)
+    return total
+
+
 def send_message(socket: zmq.Socket, data: dict, raw_buffers: bool = False, flags: int = 0):
+    """Send one message; returns the payload byte count (the senders'
+    half of per-request wire-bytes accounting)."""
     frames = encode(data, raw_buffers=raw_buffers)
     if len(frames) == 1:
         socket.send(frames[0], flags=flags)
     else:
         socket.send_multipart(frames, flags=flags, copy=False)
+    return frames_nbytes(frames)
 
 
 def recv_message(socket: zmq.Socket, flags: int = 0) -> dict:
+    return recv_message_sized(socket, flags=flags)[0]
+
+
+def recv_message_sized(socket: zmq.Socket, flags: int = 0):
+    """:func:`recv_message` plus the payload byte count — the receive
+    half of per-request wire-bytes accounting (and the ONE copy of the
+    receive/decode logic; the unsized form delegates here)."""
     frames = socket.recv_multipart(flags=flags, copy=False)
-    return decode([f.buffer for f in frames])
+    bufs = [f.buffer for f in frames]
+    return decode(bufs), frames_nbytes(bufs)
 
 
 def stamp_message_id(data: dict) -> str:
@@ -255,21 +277,31 @@ def recv_message_router(socket: zmq.Socket, flags: int = 0):
     clients speak to REP servers and ROUTER servers unmodified — the
     many-clients half of the serving tier's continuous batching
     (``blendjax/serve``)."""
+    ident, msg, _ = recv_message_router_sized(socket, flags=flags)
+    return ident, msg
+
+
+def recv_message_router_sized(socket: zmq.Socket, flags: int = 0):
+    """:func:`recv_message_router` plus the payload byte count (and the
+    ONE copy of the delimiter-strip logic; the unsized form delegates
+    here)."""
     frames = socket.recv_multipart(flags=flags, copy=True)
     ident, body = frames[0], frames[1:]
     if body and len(body[0]) == 0:
         body = body[1:]
-    return ident, decode(body)
+    return ident, decode(body), frames_nbytes(body)
 
 
 def send_message_router(socket: zmq.Socket, ident: bytes, data: dict,
                         raw_buffers: bool = False, flags: int = 0):
     """Send ``data`` to the DEALER client behind routing frame
     ``ident``, restoring the empty delimiter the client's
-    :func:`recv_message_dealer` strips."""
+    :func:`recv_message_dealer` strips.  Returns the payload byte
+    count."""
     frames = encode(data, raw_buffers=raw_buffers)
     socket.send_multipart([ident, b""] + frames, flags=flags,
                           copy=False)
+    return frames_nbytes(frames)
 
 
 def recv_message_raw(socket: zmq.Socket, flags: int = 0):

@@ -61,8 +61,15 @@ import glob as _glob  # noqa: E402
 @atexit.register
 def _cleanup_test_shm_rings():
     """Remove shm rings leaked by aborted/short-read tests (rings are only
-    auto-unlinked when a reader drains them to EOF)."""
+    auto-unlinked when a reader drains them to EOF), and ShmRPC objects
+    whose base embeds this pid (abandoned in-process servers — crash
+    stand-ins that never ran close())."""
     for p in _glob.glob(f"/dev/shm/bjx-test-*-{os.getpid()}"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    for p in _glob.glob(f"/dev/shm/bjxrpc-*-{os.getpid():x}-*"):
         try:
             os.unlink(p)
         except OSError:

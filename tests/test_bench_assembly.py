@@ -174,6 +174,24 @@ def test_headline_flags_invalid_seqformer_duty():
     assert line["seq_duty_invalid"] is True
 
 
+def test_headline_carries_shm_rpc_x():
+    """ISSUE-12: the shm-vs-tcp service ratio rides the headline next
+    to replay_shard_x (whose service arm now rides the shm wire)."""
+    rb = {
+        "phase": "replay_bench", "replay_sample_x": 3.9,
+        "sharded": {"shards": 2, "capacity": 2048, "batch": 32,
+                    "transport": "shm",
+                    "replay_shard_batches_per_sec": {},
+                    "replay_shard_x": 0.37, "shm_rpc_x": 1.6,
+                    "replay_degraded_x": 1.2},
+    }
+    out = assemble({}, host_fallback=lambda: 1.0, replay_bench=rb)
+    line = headline(out)
+    assert line["replay_shard_x"] == 0.37
+    assert line["shm_rpc_x"] == 1.6
+    assert line["replay_degraded_x"] == 1.2
+
+
 def test_headline_tail_window_self_sufficient():
     """The compact line printed LAST must fit a 400-byte tail capture and
     carry the verdict even when the full line is truncated (the r04

@@ -47,7 +47,8 @@ def test_extract_headline_and_full_line(tmp_path):
                        "telemetry_overhead_x": 0.97},
         "replay_bench": {
             "replay_sample_x": 3.9,
-            "sharded": {"replay_shard_x": 0.25, "replay_degraded_x": 1.1},
+            "sharded": {"replay_shard_x": 0.25, "shm_rpc_x": 1.6,
+                        "replay_degraded_x": 1.1},
         },
         "rl_steps_per_sec": 12000.0,
     }
@@ -56,6 +57,7 @@ def test_extract_headline_and_full_line(tmp_path):
     )
     assert m["feed_arena_x"] == 1.35
     assert m["replay_shard_x"] == 0.25
+    assert m["shm_rpc_x"] == 1.6  # ISSUE-12: floor-guarded transport win
     assert m["rl_steps_per_sec"] == 12000.0
 
 

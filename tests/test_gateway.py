@@ -392,16 +392,24 @@ def test_client_surfaces_replica_id_in_error_and_spans():
 
 
 @pytest.mark.chaos
-def test_exactly_once_through_gateway_with_wire_faults():
-    """ChaosProxy between client and GATEWAY: dropped replies and
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_exactly_once_through_gateway_with_wire_faults(transport):
+    """Wire faults between client and GATEWAY: dropped replies and
     duplicated requests across the two-hop path still yield exactly one
     applied step per submitted request — the gateway forwards BTMID
     verbatim, re-forwards in-flight retries to the SAME replica, and
-    answers executed retries from its own reply cache."""
+    answers executed retries from its own reply cache.  Parametrized
+    over both wires (ISSUE-12): ``tcp`` injects at the TCP chunk layer
+    (ChaosProxy, shm pinned off), ``shm`` at the ring frame layer
+    (ShmChaos) on the client->gateway hop — with the gateway->replica
+    hop ALSO riding its own shm channel."""
     from blendjax.btt.chaos import ChaosProxy
+    from blendjax.btt.shm_rpc import ShmChaos, enabled
     from blendjax.serve import LinearModel, ServeClient, start_server_thread
     from blendjax.serve.gateway import start_gateway_thread
 
+    if transport == "shm" and not enabled():
+        pytest.skip("shm rpc unavailable on this host")
     counters = EventCounters()
     obs = np.arange(4, dtype=np.float32)
     ref = LinearModel(obs_dim=4, slots=2, seed=0)
@@ -409,39 +417,74 @@ def test_exactly_once_through_gateway_with_wire_faults():
     h = start_server_thread(
         LinearModel(obs_dim=4, slots=2, seed=0), counters=EventCounters()
     )
+    proxy = None
+    chaos = None
     try:
-        with start_gateway_thread([h.address], counters=counters) as gw:
-            with ChaosProxy(gw.address) as proxy:
+        with start_gateway_thread(
+            [h.address], counters=counters, scrape_interval_s=0.1
+        ) as gw:
+            if transport == "tcp":
+                proxy = ChaosProxy(gw.address)
                 client = ServeClient(
                     proxy.address,
                     fault_policy=FaultPolicy(
                         max_retries=4, backoff_base=0.02,
                         backoff_max=0.1, circuit_threshold=0, seed=1,
                     ),
-                    counters=counters, timeoutms=400,
+                    counters=counters, timeoutms=400, shm=False,
                 )
-                client.reset()
-                preds = []
-                for t in range(16):
-                    if t == 4:
+            else:
+                chaos = ShmChaos(seed=1)
+                client = ServeClient(
+                    gw.address,
+                    fault_policy=FaultPolicy(
+                        max_retries=4, backoff_base=0.02,
+                        backoff_max=0.1, circuit_threshold=0, seed=1,
+                    ),
+                    counters=counters, timeoutms=400, shm_chaos=chaos,
+                )
+            client.reset()
+            preds = []
+            for t in range(16):
+                if t == 4:
+                    if proxy is not None:
                         proxy.drop_next("down")  # lose a reply -> retry
-                    if t == 9:
-                        proxy.dup_next("up")     # duplicate a request
-                    preds.append(client.step(obs)["pred"])
-                want = [ref.step_rows(np.asarray([0]), obs[None])[0]
-                        for _ in range(16)]
-                np.testing.assert_allclose(np.stack(preds),
-                                           np.stack(want))
-                snap = counters.snapshot()
-                assert snap.get("retries", 0) >= 1
-                # the retry was healed on the gateway/replica side, not
-                # by accident: a cache hit or an in-flight re-forward
-                assert (
-                    snap.get("gateway_cache_hits", 0)
-                    + snap.get("gateway_dup_inflight", 0)
-                ) >= 1, snap
-                client.close()
+                    else:
+                        assert client.transport == "shm", \
+                            "client->gateway upgrade never happened"
+                        chaos.drop_next("down")
+                if t == 9:
+                    (proxy or chaos).dup_next("up")  # duplicate request
+                preds.append(client.step(obs)["pred"])
+            want = [ref.step_rows(np.asarray([0]), obs[None])[0]
+                    for _ in range(16)]
+            np.testing.assert_allclose(np.stack(preds),
+                                       np.stack(want))
+            snap = counters.snapshot()
+            assert snap.get("retries", 0) >= 1
+            # the retry was healed on the gateway/replica side, not
+            # by accident: a cache hit or an in-flight re-forward
+            assert (
+                snap.get("gateway_cache_hits", 0)
+                + snap.get("gateway_dup_inflight", 0)
+            ) >= 1, snap
+            if transport == "shm":
+                # the gateway->replica hop negotiated its own channel
+                # off the scrape cycle: the step traffic moved bytes
+                # through the replica's shm transport
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if any(r.shm is not None
+                           for r in gw.gateway._replicas.values()):
+                        break
+                    time.sleep(0.05)
+                assert any(r.shm is not None
+                           for r in gw.gateway._replicas.values()), \
+                    "gateway->replica hop never upgraded"
+            client.close()
     finally:
+        if proxy is not None:
+            proxy.close()
         h.close()
 
 
@@ -545,6 +588,16 @@ def test_kill_one_replica_of_three_respawn_exactly_once():
                     c.close()
         finally:
             gw.close()
+    # no leaked /dev/shm objects (ISSUE-12): the SIGKILLed replica ran
+    # no cleanup, but the respawn path swept its generation and fleet
+    # teardown swept the rest — rings, bells, client-side halves
+    from blendjax.btt.shm_rpc import leaked_objects
+
+    for p in fleet._procs:
+        if p.shm_base is not None:
+            assert not leaked_objects(p.shm_base), leaked_objects(
+                p.shm_base
+            )
 
 
 # ---------------------------------------------------------------------------

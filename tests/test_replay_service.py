@@ -319,12 +319,50 @@ def test_all_shards_dead_raises_diagnosable_timeout():
     assert isinstance(ei.value, TimeoutError)  # learner tail skips it
 
 
-def test_exactly_once_through_lossy_wire(shard4):
-    """Stall the wire so the first attempt times out and is retried:
-    both copies eventually arrive, the shard applies the append ONCE
-    (reply cache keyed by the correlation id)."""
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_exactly_once_through_lossy_wire(shard4, transport):
+    """Lose/duplicate append traffic so retries happen: however many
+    request copies reach the shard, it applies the append ONCE (reply
+    cache keyed by the correlation id).  Parametrized over both wires
+    (ISSUE-12): ``tcp`` stalls the TCP relay (ChaosProxy, shm pinned
+    off), ``shm`` injects at the ring frame layer (ShmChaos) — a
+    duplicated in-ring request deduped by the reply cache, then a
+    dropped one whose same-mid retry rides the demoted ZMQ path."""
     from blendjax.btt.chaos import ChaosProxy
+    from blendjax.btt.shm_rpc import ShmChaos, enabled
 
+    if transport == "shm":
+        if not enabled():
+            pytest.skip("shm rpc unavailable on this host")
+        chaos = ShmChaos(seed=2)
+        policy = FaultPolicy(
+            max_retries=2, backoff_base=0.01, backoff_max=0.05,
+            circuit_threshold=0, seed=2,
+        )
+        buf = ShardedReplay(
+            [shard4[0].address], seed=0, fault_policy=policy,
+            timeoutms=300,
+        )
+        _fill(buf, 4)  # rpc #2 upgrades mid-fill
+        assert buf.clients[0].transport == "shm"
+        buf.clients[0]._channel()._shm.chaos = chaos
+        base_seq = buf.stats()["shards"]["acked"][0]
+        # duplicated request: two copies in the ring, applied once
+        chaos.dup_next("up")
+        buf.append(_row(99))
+        hello = shard4[0].shard.handle({"cmd": "hello"})
+        assert hello["seq"] == base_seq + 1
+        # dropped request: the attempt times out, the channel demotes,
+        # and the SAME-mid retry rides ZMQ — applied exactly once
+        chaos.drop_next("up")
+        buf.append(_row(100))
+        assert buf.clients[0].transport == "tcp"
+        hello = shard4[0].shard.handle({"cmd": "hello"})
+        assert hello["seq"] == base_seq + 2
+        assert buf.stats()["shards"]["acked"][0] == base_seq + 2
+        assert chaos.duplicated >= 1 and chaos.dropped >= 1
+        buf.close()
+        return
     with ChaosProxy(shard4[0].address) as proxy:
         policy = FaultPolicy(
             max_retries=2, backoff_base=0.01, backoff_max=0.05,
@@ -332,6 +370,7 @@ def test_exactly_once_through_lossy_wire(shard4):
         )
         buf = ShardedReplay(
             [proxy.address], seed=0, fault_policy=policy, timeoutms=250,
+            shm=False,
         )
         _fill(buf, 4)
         base_seq = buf.stats()["shards"]["acked"][0]
@@ -509,4 +548,27 @@ def test_kill_one_shard_degraded_then_crash_exact_readmission(tmp_path):
                 for key in data:
                     np.testing.assert_array_equal(d2[key], data[key])
             ref.close()
+            # the TRANSPORT healed too (ISSUE-12): the killed shard's
+            # channel demoted to ZMQ at quarantine, and re-upgrades
+            # onto the respawned process's fresh ring generation once
+            # traffic resumes
+            from blendjax.btt.shm_rpc import enabled as shm_enabled
+
+            if shm_enabled():
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline \
+                        and buf.clients[1].transport != "shm":
+                    buf.sample(8)
+                    time.sleep(0.05)
+                assert buf.clients[1].transport == "shm", \
+                    "shard 1's channel never re-upgraded after respawn"
         buf.close()
+    # no leaked /dev/shm objects (ISSUE-12): the SIGKILLed shard ran no
+    # cleanup, but the respawn path swept its dead generation and the
+    # fleet teardown swept everything else — rings, bells, the client-
+    # side channel halves (all named under the parent-known prefix)
+    from blendjax.btt.shm_rpc import leaked_objects
+
+    for base in fleet.shm_bases:
+        if base is not None:
+            assert not leaked_objects(base), leaked_objects(base)

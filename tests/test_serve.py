@@ -360,7 +360,9 @@ def test_multi_model_single_workload_replies_identical():
         for key in vs:
             if isinstance(vs[key], np.ndarray):
                 assert vs[key].tobytes() == vm[key].tobytes(), (ks, key)
-            elif key != "pid":
+            elif key not in ("pid", "shm"):
+                # pid and the shm endpoint advertisement are process
+                # identity, not workload semantics
                 assert vs[key] == vm[key], (ks, key)
 
 
@@ -656,13 +658,17 @@ def test_malformed_requests_error_but_server_survives():
 
 
 @pytest.mark.chaos
-def test_exactly_once_through_drop_dup_and_stall():
-    """ChaosProxy between ServeClient and PolicyServer: dropped
-    replies, duplicated requests and a stall-then-flood must each yield
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_exactly_once_through_drop_dup_and_stall(transport):
+    """Wire faults between ServeClient and PolicyServer must each yield
     EXACTLY one applied step per submitted request — the LinearModel's
     position counter rides every prediction, so a double-applied step
-    shifts every later value and the reference comparison catches it."""
-    from blendjax.btt.chaos import ChaosProxy
+    shifts every later value and the reference comparison catches it.
+    Parametrized over BOTH wires (ISSUE-12): the ``tcp`` arm injects at
+    the TCP chunk layer (ChaosProxy, shm upgrade pinned off), the
+    ``shm`` arm at the ring frame layer (ShmChaos) — the shared
+    ``btt/rpc.py`` discipline is locked on each, not just the one it
+    was written against."""
     from blendjax.serve import LinearModel, ServeClient, start_server_thread
 
     counters = EventCounters()
@@ -670,44 +676,113 @@ def test_exactly_once_through_drop_dup_and_stall():
     ref = LinearModel(obs_dim=4, slots=2, seed=0)
     obs = np.arange(4, dtype=np.float32)
     with start_server_thread(model, counters=counters) as h:
-        with ChaosProxy(h.address) as proxy:
-            client = ServeClient(
-                proxy.address,
-                fault_policy=FaultPolicy(
-                    max_retries=4, backoff_base=0.02, backoff_max=0.1,
-                    circuit_threshold=0, seed=1,
-                ),
-                counters=counters, timeoutms=400,
-            )
-            client.reset()
-            ref.reset_rows(np.asarray([0]))
-            preds = []
-            for t in range(20):
-                if t == 5:
-                    proxy.drop_next("down")   # lose a reply -> retry
-                if t == 9:
-                    proxy.dup_next("up")      # duplicate a request
-                if t == 13:
-                    proxy.stall()
+        if transport == "tcp":
+            _serve_chaos_tcp_arm(h, counters, ref, obs)
+        else:
+            _serve_chaos_shm_arm(h, counters, ref, obs)
 
-                    def unstall():
-                        time.sleep(0.6)  # past the 400 ms attempt
-                        proxy.resume()
 
-                    threading.Thread(target=unstall, daemon=True).start()
-                preds.append(client.step(obs)["pred"])
-            want = [ref.step_rows(np.asarray([0]), obs[None])[0]
-                    for _ in range(20)]
-            np.testing.assert_allclose(np.stack(preds), np.stack(want))
-            snap = counters.snapshot()
-            # the faults actually happened and were healed by the
-            # exactly-once machinery, not by luck
-            assert snap.get("retries", 0) >= 2
-            assert (
-                snap.get("serve_cache_hits", 0)
-                + snap.get("serve_dup_inflight", 0)
-            ) >= 1
-            client.close()
+def _serve_chaos_tcp_arm(h, counters, ref, obs):
+    from blendjax.btt.chaos import ChaosProxy
+    from blendjax.serve import ServeClient
+
+    with ChaosProxy(h.address) as proxy:
+        client = ServeClient(
+            proxy.address,
+            fault_policy=FaultPolicy(
+                max_retries=4, backoff_base=0.02, backoff_max=0.1,
+                circuit_threshold=0, seed=1,
+            ),
+            counters=counters, timeoutms=400, shm=False,
+        )
+        client.reset()
+        ref.reset_rows(np.asarray([0]))
+        preds = []
+        for t in range(20):
+            if t == 5:
+                proxy.drop_next("down")   # lose a reply -> retry
+            if t == 9:
+                proxy.dup_next("up")      # duplicate a request
+            if t == 13:
+                proxy.stall()
+
+                def unstall():
+                    time.sleep(0.6)  # past the 400 ms attempt
+                    proxy.resume()
+
+                threading.Thread(target=unstall, daemon=True).start()
+            preds.append(client.step(obs)["pred"])
+        want = [ref.step_rows(np.asarray([0]), obs[None])[0]
+                for _ in range(20)]
+        np.testing.assert_allclose(np.stack(preds), np.stack(want))
+        snap = counters.snapshot()
+        # the faults actually happened and were healed by the
+        # exactly-once machinery, not by luck
+        assert snap.get("retries", 0) >= 2
+        assert (
+            snap.get("serve_cache_hits", 0)
+            + snap.get("serve_dup_inflight", 0)
+        ) >= 1
+        client.close()
+
+
+def _serve_chaos_shm_arm(h, counters, ref, obs):
+    """Frame-layer faults on the upgraded channel: a duplicated request
+    (stays on shm — reply-cache/in-queue dedupe), then a dropped reply
+    whose same-mid retry rides the DEMOTED ZMQ path and is answered
+    from the server's reply cache (exactly-once ACROSS the transports
+    — the respawn-heal discipline in miniature), then the re-upgrade
+    onto a fresh ring generation."""
+    from blendjax.btt.shm_rpc import ShmChaos, enabled
+    from blendjax.serve import ServeClient
+
+    if not enabled():
+        pytest.skip("shm rpc unavailable on this host")
+    chaos = ShmChaos(seed=1)
+    client = ServeClient(
+        h.address,
+        fault_policy=FaultPolicy(
+            max_retries=4, backoff_base=0.02, backoff_max=0.1,
+            circuit_threshold=0, seed=1,
+        ),
+        counters=counters, timeoutms=400, shm_chaos=chaos,
+    )
+    client.reset()
+    ref.reset_rows(np.asarray([0]))
+    preds = []
+    for t in range(20):
+        if t == 4:
+            assert client.transport == "shm", "upgrade never happened"
+            chaos.dup_next("up")      # duplicate a request in the ring
+        if t == 8:
+            chaos.drop_next("down")   # lose a reply -> timeout ->
+            #                           demote -> same-mid retry on zmq
+        preds.append(client.step(obs)["pred"])
+    # the dropped reply demoted the channel: its retry rode ZMQ
+    assert client.transport == "tcp"
+    want = [ref.step_rows(np.asarray([0]), obs[None])[0]
+            for _ in range(20)]
+    np.testing.assert_allclose(np.stack(preds), np.stack(want))
+    snap = counters.snapshot()
+    assert snap.get("retries", 0) >= 1
+    assert (
+        snap.get("serve_cache_hits", 0)
+        + snap.get("serve_dup_inflight", 0)
+    ) >= 1, snap
+    assert chaos.dropped >= 1 and chaos.duplicated >= 1
+    # generation heal: once the (live) server answers on ZMQ and the
+    # backoff elapses, the channel re-upgrades onto fresh rings
+    time.sleep(1.1)
+    for _ in range(3):
+        preds.append(client.step(obs)["pred"])
+    assert client.transport == "shm", "channel never re-upgraded"
+    assert client._chan.generations == 2
+    np.testing.assert_allclose(
+        np.stack(preds[-3:]),
+        np.stack([ref.step_rows(np.asarray([0]), obs[None])[0]
+                  for _ in range(3)]),
+    )
+    client.close()
 
 
 @pytest.mark.chaos
