@@ -11,7 +11,7 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
 	rlbench rlbench-sharded replaybench shmbench servebench \
-	gatewaybench multichip dryrun benchdiff obsdemo
+	gatewaybench weightbench multichip dryrun benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -171,6 +171,17 @@ gatewaybench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		$(PYTHON) benchmarks/serve_benchmark.py \
 		--gateway --replicas 3 --seconds 18 --clients 16
+
+# WeightBus live-rollout microbench (docs/weight_bus.md): 6 concurrent
+# episode clients against one subscribed linear-model server while an
+# in-process publisher pushes a fresh 256 KiB versioned snapshot every
+# ~800 ms.  One JSON line with weight_swap_ms (publish -> first
+# client-observed reply at the new version, p99 over the window's
+# swaps; ceiling-guarded in bench_compare) and weight_swap_qps_dip_x
+# (QPS through the swap over steady state; floor 0.80).  Jax-free.
+weightbench:
+	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/weight_benchmark.py \
+		--seconds 10 --clients 6
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

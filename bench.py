@@ -396,10 +396,32 @@ def main():
         )
         gateway_bench = gw_lines[-1] if gw_lines else None
 
+    # seventh configuration: the WeightBus live-rollout cost
+    # (docs/weight_bus.md) — a subscribed linear-model server under
+    # live traffic while versioned snapshots publish and hot-swap:
+    # weight_swap_ms (publish -> first serving reply at the new
+    # version, p99) and weight_swap_qps_dip_x (QPS through the swap
+    # over steady state).  Jax-free.
+    weight_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 25:
+        wb_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "weight_benchmark.py"),
+                "--seconds", "10",
+                "--clients", "6",
+            ],
+            rl_env,
+            min(60, remaining),
+        )
+        weight_bench = wb_lines[-1] if wb_lines else None
+
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
                    replay_bench=replay_bench, rl_sharded=rl_sharded,
-                   serve_bench=serve_bench, gateway_bench=gateway_bench)
+                   serve_bench=serve_bench, gateway_bench=gateway_bench,
+                   weight_bench=weight_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -443,6 +465,7 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
+    ("weight_swap_ms", "weight_swap_qps_dip_x"),
     ("serve_int8_x",),
     ("serve_prefill_x",),
     ("shm_rpc_x",),
@@ -528,6 +551,13 @@ def headline(out):
             line["gateway_p99_ms"] = gb["gateway_p99_ms"]
         if gb.get("gateway_scale_x") is not None:
             line["gateway_scale_x"] = gb["gateway_scale_x"]
+    wb = out.get("weight_bench")
+    if wb and wb.get("weight_swap_ms") is not None:
+        # the live-rollout headline: publish -> first serving reply at
+        # the new version (p99) and the QPS dip through the swap
+        line["weight_swap_ms"] = wb["weight_swap_ms"]
+        if wb.get("weight_swap_qps_dip_x") is not None:
+            line["weight_swap_qps_dip_x"] = wb["weight_swap_qps_dip_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -580,7 +610,8 @@ def headline(out):
 
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
-             rl_sharded=None, serve_bench=None, gateway_bench=None):
+             rl_sharded=None, serve_bench=None, gateway_bench=None,
+             weight_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -614,6 +645,21 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
                 "pair_ratios", "gateway_counters", "stages",
             )
             if k in gateway_bench
+        }
+    if weight_bench and weight_bench.get("phase") == "weight_bench":
+        # the live-rollout cost record: publish -> first-serving-reply
+        # swap latency and the QPS dip through the swap — see
+        # benchmarks/weight_benchmark.py
+        extras["weight_bench"] = {
+            k: weight_bench[k]
+            for k in (
+                "clients", "publishes", "window_s", "snapshot_kb",
+                "weight_swap_ms", "weight_swap_ms_p50",
+                "weight_swap_qps_dip_x", "qps_steady",
+                "swaps_observed", "swap_ms_all", "publish_ms_p50",
+                "weight_counters", "stages",
+            )
+            if k in weight_bench
         }
     if feed_bound:
         # the feed ceiling, legacy vs arena assembly (trivial train step,

@@ -97,6 +97,23 @@ GATEWAY_BENCH_KEYS = (
 )
 
 
+#: Result-schema keys every ``weight_benchmark.py`` JSON line carries
+#: (phase ``weight_bench``); ``bench.py`` keys off these and
+#: ``tests/test_weights.py`` locks emission against this tuple.
+#: ``weight_swap_ms`` is publish() -> first client-observed reply at
+#: the new version, p99 over the window's publishes (p50 rides as
+#: ``weight_swap_ms_p50``); ``weight_swap_qps_dip_x`` is aggregate QPS
+#: in the buckets around each swap over the steady-state median (1.0 =
+#: rollouts cost nothing).
+WEIGHT_BENCH_KEYS = (
+    "clients", "obs_dim", "publishes", "window_s", "snapshot_kb",
+    "weight_swap_ms", "weight_swap_ms_p50", "weight_swap_qps_dip_x",
+    "qps_steady", "swaps_observed", "swap_ms_all", "publish_ms_p50",
+    "weight_counters",
+    "stages",            # weight_publish / weight_assemble / weight_swap
+)
+
+
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)
 

@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""WeightBus microbench: what a live weight rollout costs the serving
+tier (``make weightbench``, docs/weight_bus.md).
+
+One continuously-batched jax-free :class:`~blendjax.serve.server.
+LinearModel` server subscribed to an in-process
+:class:`~blendjax.weights.bus.WeightPublisher`, N concurrent episode
+clients stepping flat out; the publisher pushes a fresh versioned
+snapshot (version-seeded weights + per-version random ballast, so the
+payload is ``--snapshot-kb`` of genuinely changed bytes every time —
+leaf deltas cannot elide it) every few hundred milliseconds of the
+timed window.  Every client records the wall time of each reply and
+the first reply at every new ``weight_version``.  Two headline
+numbers:
+
+- ``weight_swap_ms`` — publish() return to the first CLIENT-OBSERVED
+  reply at the new version (p99 over the window's publishes; p50 rides
+  as ``weight_swap_ms_p50``).  This is the full pipeline: snapshot +
+  digest + chunk + stream + assemble + verify + between-ticks hot-swap
+  + one serving round-trip;
+- ``weight_swap_qps_dip_x`` — aggregate client QPS in the 100 ms
+  buckets around each swap over the steady-state median bucket (1.0 =
+  rollouts are free; the floor in ``bench_compare`` guards it).
+
+One JSON line; keys locked by ``benchmarks/_common.WEIGHT_BENCH_KEYS``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: QPS-timeline bucket width: fine enough to see a swap-tick stall,
+#: coarse enough that a bucket holds many requests at bench rates.
+BUCKET_S = 0.1
+
+
+def _ballast_tree(version, obs_dim, snapshot_kb, rng):
+    """A published tree whose ``w`` identifies the version (the
+    LinearModel seed recipe) and whose ``ballast`` leaf pads the
+    snapshot to ``snapshot_kb`` of per-version random bytes — the
+    realistic case where every leaf changed, so deltas ship it all."""
+    from blendjax.weights.bus import linear_tree
+
+    tree = linear_tree(version, obs_dim)
+    pad = max(0, snapshot_kb * 1024 - tree["w"].nbytes)
+    if pad:
+        tree["ballast"] = rng.integers(
+            0, 255, size=pad, dtype=np.uint8
+        )
+    return tree
+
+
+def measure(seconds=10.0, clients=6, *, obs_dim=8, publishes=8,
+            snapshot_kb=256, tick_ms=2.0, seed=0):
+    """Run the live-rollout window; returns the weight_bench record."""
+    from blendjax.serve.client import ServeClient
+    from blendjax.serve.server import LinearModel, start_server_thread
+    from blendjax.utils.timing import EventCounters, StageTimer
+    from blendjax.weights.bus import WeightPublisher, WeightSubscriber
+
+    counters, timer = EventCounters(), StageTimer()
+    rng = np.random.default_rng(seed)
+    pub = WeightPublisher(counters=counters, timer=timer).start()
+    sub = WeightSubscriber(pub.address)
+    server = start_server_thread(
+        LinearModel(obs_dim=obs_dim, slots=max(2 * clients, 8),
+                    seed=seed),
+        counters=counters, timer=timer, tick_ms=tick_ms,
+        subscriber=sub,
+    )
+    # per-client: [ (reply wall time, weight_version or None) ... ] is
+    # too much memory at bench rates — keep bucket counts + the first
+    # observation time of each version
+    nbuckets = int(seconds / BUCKET_S) + 4
+    bucket_counts = [np.zeros(nbuckets, np.int64) for _ in range(clients)]
+    first_seen = [dict() for _ in range(clients)]
+    ready = threading.Barrier(clients + 1)
+    go = threading.Barrier(clients + 1)
+    t0_box = [None]
+    errors = []
+
+    def runner(i):
+        client = ServeClient(server.address, timeoutms=10000)
+        obs = np.random.default_rng(100 + i).standard_normal(
+            obs_dim
+        ).astype(np.float32)
+        last_v = None
+        try:
+            client.reset()
+            ready.wait(timeout=30)
+            go.wait(timeout=30)
+            t0 = t0_box[0]
+            end = t0 + seconds
+            while time.perf_counter() < end:
+                r = client.step(obs)
+                now = time.perf_counter()
+                b = int((now - t0) / BUCKET_S)
+                if 0 <= b < nbuckets:
+                    bucket_counts[i][b] += 1
+                v = r.get("weight_version")
+                if v is not None and v != last_v:
+                    first_seen[i].setdefault(v, now)
+                    last_v = v
+        except Exception as exc:  # noqa: BLE001 - surface, never deflate
+            errors.append(f"client {i}: {type(exc).__name__}: {exc}")
+            ready.abort()
+            go.abort()
+        finally:
+            try:
+                client.close_episode()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            client.close()
+
+    threads = [threading.Thread(target=runner, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    pub_times = {}
+    publish_ms = []
+    try:
+        ready.wait(timeout=60)
+        t0_box[0] = time.perf_counter()
+        go.wait(timeout=30)
+        # publishes spread over the MIDDLE of the window: the edges
+        # stay publish-free so steady-state buckets exist on both sides
+        interval = seconds / (publishes + 2)
+        for k in range(publishes):
+            time.sleep(interval)
+            tp = time.perf_counter()
+            v = pub.publish(
+                _ballast_tree(pub.version + 1, obs_dim, snapshot_kb,
+                              rng),
+                step=k,
+            )
+            publish_ms.append((time.perf_counter() - tp) * 1e3)
+            pub_times[v] = tp
+    except threading.BrokenBarrierError:
+        pass  # a client died pre-start; reported below
+    for t in threads:
+        t.join(timeout=seconds + 30)
+    server.close()
+    pub.close()
+    if errors:
+        raise RuntimeError(
+            f"weight bench lost {len(errors)} client(s): "
+            + "; ".join(errors)
+        )
+    t0 = t0_box[0]
+    # swap latency: publish -> the EARLIEST client observation of the
+    # version (any client proves the fleet-visible swap landed)
+    swaps_ms = []
+    for v, tp in pub_times.items():
+        seen = [fs[v] for fs in first_seen if v in fs]
+        if seen:
+            swaps_ms.append((min(seen) - tp) * 1e3)
+    swaps_ms.sort()
+    total = np.sum(bucket_counts, axis=0)
+    rates = total / BUCKET_S
+    # steady state: buckets at least one bucket away from any swap
+    # moment (publish or first observation), edges trimmed
+    swap_buckets = set()
+    for v, tp in pub_times.items():
+        b = int((tp - t0) / BUCKET_S)
+        seen = [fs[v] for fs in first_seen if v in fs]
+        b_end = int((min(seen) - t0) / BUCKET_S) if seen else b
+        for bb in range(b - 1, b_end + 2):
+            if 0 <= bb < nbuckets:
+                swap_buckets.add(bb)
+    lived = int((min(time.perf_counter() - t0, seconds)) / BUCKET_S)
+    steady = [rates[b] for b in range(1, min(lived, nbuckets) - 1)
+              if b not in swap_buckets and rates[b] > 0]
+    swap_rates = [rates[b] for b in sorted(swap_buckets)
+                  if 0 < b < min(lived, nbuckets) - 1]
+    qps_steady = float(np.median(steady)) if steady else 0.0
+    dip_x = (
+        round(float(np.median(swap_rates)) / qps_steady, 3)
+        if steady and swap_rates and qps_steady > 0 else None
+    )
+
+    def pct(q):
+        if not swaps_ms:
+            return None
+        i = min(len(swaps_ms) - 1, int(np.ceil(q * len(swaps_ms))) - 1)
+        return round(swaps_ms[max(0, i)], 3)
+
+    snap = counters.snapshot()
+    return {
+        "clients": clients,
+        "obs_dim": obs_dim,
+        "publishes": publishes,
+        "window_s": round(seconds, 3),
+        "snapshot_kb": snapshot_kb,
+        "tick_ms": tick_ms,
+        "weight_swap_ms": pct(0.99),
+        "weight_swap_ms_p50": pct(0.50),
+        "weight_swap_qps_dip_x": dip_x,
+        "qps_steady": round(qps_steady, 2),
+        "swaps_observed": len(swaps_ms),
+        "swap_ms_all": [round(s, 3) for s in swaps_ms],
+        "publish_ms_p50": (
+            round(float(np.median(publish_ms)), 3) if publish_ms
+            else None
+        ),
+        "weight_counters": {
+            k: v for k, v in snap.items() if k.startswith("weight_")
+        },
+        "stages": {
+            k: v for k, v in timer.summary().items()
+            if k in ("weight_publish", "weight_assemble", "weight_swap")
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--obs-dim", type=int, default=8)
+    ap.add_argument("--publishes", type=int, default=8)
+    ap.add_argument("--snapshot-kb", type=int, default=256)
+    ap.add_argument("--tick-ms", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    rec = measure(
+        seconds=args.seconds, clients=args.clients,
+        obs_dim=args.obs_dim, publishes=args.publishes,
+        snapshot_kb=args.snapshot_kb, tick_ms=args.tick_ms,
+        seed=args.seed,
+    )
+    line = {
+        "metric": "weight_swap_ms",
+        "value": rec["weight_swap_ms"],
+        "unit": "ms",
+        "phase": "weight_bench",
+        **rec,
+    }
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,13 +130,25 @@ class ActorLearner:
         buffer's counters/timer when one is attached, plus a
         ``stats``-shaped probe over the fleet/step accounting) so one
         ``hub.scrape()`` covers acting AND learning.
+    weight_bus: blendjax.weights.WeightPublisher | None
+        Live weight publication to the serve tier (docs/weight_bus.md):
+        every ``publish_every``-th completed update (on-policy AND
+        off-policy — whatever advanced the params) snapshots the
+        learner params onto the bus as a versioned, checksummed
+        snapshot; subscribed :class:`~blendjax.serve.server.
+        PolicyServer` replicas hot-swap it between ticks.  The caller
+        owns the publisher (and its ``quantize=`` choice must match
+        the serving precision).
+    publish_every: int
+        Updates between bus publishes (1 = every update).
     """
 
     def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
                  queue_size=4, optimizer=None, gamma=0.99, seed=0,
                  continuous=False, action_map=None, pipeline=False,
                  mesh=None, num_fleets=None,
-                 replay=None, replay_ratio=0, replay_batch=64, hub=None):
+                 replay=None, replay_ratio=0, replay_batch=64, hub=None,
+                 weight_bus=None, publish_every=1):
         self.pools = _as_pools(pool)
         if num_fleets is not None:
             if self.pools and num_fleets != len(self.pools):
@@ -264,6 +276,9 @@ class ActorLearner:
             if replay is not None
             else None
         )
+        self.weight_bus = weight_bus
+        self.publish_every = max(1, int(publish_every))
+        self._updates_done = 0
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._fanin = None
         self._stop = threading.Event()
@@ -323,13 +338,34 @@ class ActorLearner:
         assignment).  Under a mesh the snapshot is gathered off the mesh
         onto uncommitted default-device arrays — per-env-step SPMD
         dispatch over the whole mesh (or committed-device dispatch)
-        would dwarf the tiny policy's compute; see the constructor."""
+        would dwarf the tiny policy's compute; see the constructor.
+
+        Called once per completed update, which also makes it the
+        weight-bus publication point: every ``publish_every``-th update
+        snapshots the params onto the bus (host-gathered — the same
+        gather the mesh actor path already pays), closing the
+        learner -> serve-tier loop (docs/weight_bus.md)."""
+        host = None
         if self._actor_device is not None:
-            self._actor_params = jax.tree.map(
-                jnp.asarray, jax.device_get(self.state.params)
-            )
+            host = jax.device_get(self.state.params)
+            self._actor_params = jax.tree.map(jnp.asarray, host)
         else:
             self._actor_params = self.state.params
+        self._updates_done += 1
+        if self.weight_bus is not None \
+                and self._updates_done % self.publish_every == 0:
+            try:
+                self.weight_bus.publish(
+                    # reuse the mesh path's host gather; single-device
+                    # params gather here (the only transfer they pay)
+                    host if host is not None
+                    else jax.device_get(self.state.params),
+                    step=self._updates_done,
+                )
+            except Exception:  # noqa: BLE001 - training outlives the bus
+                log.exception("weight bus publish failed (training "
+                              "continues; the serve tier keeps its "
+                              "last good version)")
 
     # -- actor side ----------------------------------------------------------
 

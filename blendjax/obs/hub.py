@@ -8,8 +8,9 @@ snapshot:
 
 - :meth:`TelemetryHub.scrape` — a JSON-able dict with every canonical
   counter (``FLEET_EVENTS`` + ``REPLAY_EVENTS`` + ``SERVE_EVENTS`` +
-  ``GATEWAY_EVENTS``) and every canonical stage (``FEED_STAGES`` +
-  ``REPLAY_STAGES`` + ``SERVE_STAGES`` + ``GATEWAY_STAGES``)
+  ``GATEWAY_EVENTS`` + ``WEIGHT_EVENTS``) and every canonical stage
+  (``FEED_STAGES`` + ``REPLAY_STAGES`` + ``SERVE_STAGES`` +
+  ``GATEWAY_STAGES`` + ``WEIGHT_STAGES``)
   **zero-filled** (the same
   contract ``FleetSupervisor.health()`` keeps: dashboards and tests
   need no existence checks), histograms merged across components so the
@@ -49,14 +50,16 @@ def _canonical_counters():
     from blendjax.utils import timing
 
     return (timing.FLEET_EVENTS + timing.REPLAY_EVENTS
-            + timing.SERVE_EVENTS + timing.GATEWAY_EVENTS)
+            + timing.SERVE_EVENTS + timing.GATEWAY_EVENTS
+            + timing.WEIGHT_EVENTS)
 
 
 def _canonical_stages():
     from blendjax.utils import timing
 
     return (timing.FEED_STAGES + timing.REPLAY_STAGES
-            + timing.SERVE_STAGES + timing.GATEWAY_STAGES)
+            + timing.SERVE_STAGES + timing.GATEWAY_STAGES
+            + timing.WEIGHT_STAGES)
 
 
 def _zero_stage():

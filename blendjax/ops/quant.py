@@ -209,6 +209,27 @@ def quantize_policy(params):
     return out
 
 
+def quantize_for_wire(params, kind):
+    """Model-kind dispatch over the offline PTQ entry points — the
+    :mod:`blendjax.weights` publisher's wire quantizer: attention/MLP/
+    head weights ship int8 (quarter the snapshot bytes), while the
+    leaves each quantizer deliberately keeps float (layernorms, biases,
+    position tables, MoE gates — precision-sensitive) ride the float
+    fallback unchanged.  ``kind=None`` is the identity (float wire)."""
+    if kind is None:
+        return params
+    if kind == "seqformer":
+        return quantize_seqformer(params)
+    if kind == "policy":
+        return quantize_policy(params)
+    if kind == "detector":
+        return quantize_detector(params)
+    raise ValueError(
+        f"unknown wire-quantization kind {kind!r}; expected one of "
+        "seqformer/policy/detector or None"
+    )
+
+
 def quantize_detector(params):
     """Offline PTQ of a trained :mod:`blendjax.models.detector` pytree:
     every conv and dense layer goes w8; biases stay f32."""

@@ -65,6 +65,11 @@ class ServeClient:
         #: ServeRPCError text and span args so a misbehaving replica is
         #: diagnosable from a client traceback alone
         self.replica = None
+        #: the WeightBus version that served the LAST reply (stamped by
+        #: subscribed servers; None against a bus-less server) —
+        #: surfaced alongside the replica stamp, so a bad-version
+        #: rollout is diagnosable from a client traceback alone
+        self.weight_version = None
         #: cross-process span sink (None = tracing off): client RPC
         #: spans plus the server's piggybacked serve-side spans
         self.spans = span_recorder
@@ -108,11 +113,19 @@ class ServeClient:
 
         msg = dict(payload or {})
         msg["cmd"] = cmd
-        # the last replica that answered (gateway-stamped) rides the
-        # transport-error text and the client span: when a fleet
-        # misbehaves, the traceback names the suspect replica
+        # the last replica (gateway-stamped) and weight version
+        # (bus-stamped) that answered ride the transport-error text and
+        # the client span: when a fleet or a rollout misbehaves, the
+        # traceback names the suspect replica AND the suspect version
         via = (f", last replica {self.replica}"
                if self.replica is not None else "")
+        if self.weight_version is not None:
+            via += f", weights v{self.weight_version}"
+        span_args = {}
+        if self.replica is not None:
+            span_args["replica"] = self.replica
+        if self.weight_version is not None:
+            span_args["weight_version"] = self.weight_version
         reply = exactly_once_rpc(
             self._channel, msg,
             policy=self.policy, state=self.state,
@@ -122,8 +135,7 @@ class ServeClient:
             raw_buffers=raw_buffers, spans=self.spans,
             remote_name="policy server",
             span_label="serve_rpc", span_cat="serve_client",
-            span_args=({"replica": self.replica}
-                       if self.replica is not None else None),
+            span_args=span_args or None,
             rpc_name=f"{self.name}:{cmd}",
             exc_factory=lambda text: ServeRPCError(
                 f"policy server ({self.address}{via}): {text}"
@@ -134,6 +146,9 @@ class ServeClient:
         rep = reply.get("replica")
         if rep is not None:
             self.replica = rep
+        wv = reply.get("weight_version")
+        if wv is not None:
+            self.weight_version = wv
         return reply
 
     # -- episode protocol ----------------------------------------------------

@@ -97,8 +97,15 @@ logger = logging.getLogger("blendjax")
 ROUTE_CACHE_DEPTH = 8192
 
 #: Commands the gateway answers itself (never forwarded): aggregate
-#: capability/stats/telemetry plus the drain lifecycle.
-GATEWAY_CMDS = ("hello", "stats", "telemetry", "drain", "undrain")
+#: capability/stats/telemetry, the drain lifecycle, and the weight-bus
+#: canary lifecycle (docs/weight_bus.md).
+GATEWAY_CMDS = ("hello", "stats", "telemetry", "drain", "undrain",
+                "canary", "promote", "rollback")
+
+#: Per-weight-version reply metrics kept (newest versions win): enough
+#: for a canary + stable + a few predecessors, bounded regardless of
+#: publish rate.
+VERSION_STATS_DEPTH = 8
 
 
 class _Replica:
@@ -109,7 +116,7 @@ class _Replica:
         "id", "address", "sock", "healthy", "draining", "models",
         "queued", "live", "p99_ms", "pending_live", "last_ok",
         "incarnation", "scrape_mid", "scrape_sent", "next_scrape", "pid",
-        "caps", "shm", "shm_state", "shm_next_try",
+        "caps", "shm", "shm_state", "shm_next_try", "weight_version",
     )
 
     def __init__(self, rid, address, sock, now):
@@ -138,6 +145,9 @@ class _Replica:
         self.shm = None
         self.shm_state = "idle"  # idle | pending | active | off
         self.shm_next_try = 0.0
+        #: scraped WeightBus version (None = no snapshot adopted yet,
+        #: or a pre-bus replica) — what canary routing keys on
+        self.weight_version = None
 
     def hosts(self, model):
         return model is None or self.models is None or model in self.models
@@ -160,6 +170,7 @@ class _Replica:
             "p99_ms": round(self.p99_ms, 3),
             "incarnation": self.incarnation,
             "pid": self.pid,
+            "weight_version": self.weight_version,
         }
 
 
@@ -281,6 +292,24 @@ class ServeGateway:
             )
         #: in-flight backend upgrade handshakes: mid -> (phase, rid)
         self._shm_connects = {}
+        #: weight-bus canary state (docs/weight_bus.md): while a canary
+        #: window is open, fresh episodes split between replicas at the
+        #: canary version (``_canary_fraction`` of them, paced by the
+        #: deterministic accumulator) and replicas at any OTHER known
+        #: version; a rolled-back version is avoided for fresh traffic
+        #: until its replicas move off it (rollback republish)
+        self._canary_version = None
+        self._canary_fraction = 0.0
+        self._canary_acc = 0.0
+        self._stable_version = None
+        self._rejected_version = None
+        #: per-weight-version reply metrics (requests / errors / client
+        #: round-trip histogram through this gateway) — what the
+        #: WeightBusController's promote/rollback verdicts read.  The
+        #: lock matters: the gateway IO thread inserts/evicts while a
+        #: controller thread iterates via version_stats()
+        self._version_stats = OrderedDict()
+        self._version_stats_lock = threading.Lock()
 
     # -- admin (callable from any thread; applied under the GIL) -------------
 
@@ -296,6 +325,115 @@ class ServeGateway:
     def undrain(self, rid):
         self._replicas[rid].draining = False
         return True
+
+    def canary(self, version, fraction=0.25):
+        """Open a canary window: route ``fraction`` of FRESH episodes
+        to replicas whose scraped ``weight_version`` equals
+        ``version``; the rest go to replicas at other known versions.
+        Replicas at NO known version (a respawned process that has not
+        caught up to the bus yet) get no fresh episodes while a window
+        is open — re-admission for canary traffic is version-gated."""
+        self._canary_version = int(version)
+        self._canary_fraction = float(fraction)
+        self._canary_acc = 0.0
+        if self._rejected_version == self._canary_version:
+            self._rejected_version = None  # an explicit second chance
+        self.counters.incr("weight_canary_starts")
+        return self._canary_version
+
+    def promote(self):
+        """The open canary version becomes stable; the window closes
+        (fresh episodes stop being version-split)."""
+        if self._canary_version is None:
+            return False
+        self._stable_version = self._canary_version
+        self._canary_version = None
+        self._canary_fraction = 0.0
+        self.counters.incr("weight_canary_promotions")
+        return True
+
+    def rollback(self):
+        """Close the canary window and REJECT its version: fresh
+        episodes avoid replicas still at it (until a rollback republish
+        moves them forward to the old weights)."""
+        if self._canary_version is None:
+            return False
+        self._rejected_version = self._canary_version
+        self._canary_version = None
+        self._canary_fraction = 0.0
+        self.counters.incr("weight_canary_rollbacks")
+        return True
+
+    def set_stable(self, version):
+        """Record the stable (baseline) weight version — the
+        controller's bootstrap for the first version a fleet reports."""
+        self._stable_version = None if version is None else int(version)
+
+    @property
+    def canary_version(self):
+        return self._canary_version
+
+    @property
+    def stable_version(self):
+        return self._stable_version
+
+    @property
+    def rejected_version(self):
+        return self._rejected_version
+
+    def fleet_versions(self):
+        """``{rid: scraped weight_version}`` over HEALTHY replicas."""
+        return {r.id: r.weight_version
+                for r in self._replicas.values() if r.healthy}
+
+    def version_stats(self):
+        """Per-weight-version reply metrics: ``{version: {"requests",
+        "errors", "p50_ms", "p99_ms"}}`` (client round-trip through
+        this gateway, errors included in the counts)."""
+        with self._version_stats_lock:
+            items = [(v, rec["requests"], rec["errors"],
+                      rec["hist"].copy())
+                     for v, rec in self._version_stats.items()]
+        out = {}
+        for v, requests, errors, hist in items:
+            pct = hist.percentiles()
+            out[v] = {
+                "requests": requests,
+                "errors": errors,
+                "p50_ms": pct["p50_ms"],
+                "p99_ms": pct["p99_ms"],
+            }
+        return out
+
+    def _note_version_reply(self, version, is_error, latency_s):
+        with self._version_stats_lock:
+            rec = self._version_stats.get(version)
+            if rec is None:
+                rec = self._version_stats[version] = {
+                    "requests": 0, "errors": 0,
+                    "hist": LatencyHistogram(),
+                }
+                # evict oldest-first, but NEVER the stable or canary
+                # record: those are exactly what the controller's
+                # promote/rollback verdicts diff against, and a
+                # fast-publishing learner would otherwise age the
+                # stable baseline out and silently disable the p99
+                # regression check
+                keep = {self._stable_version, self._canary_version,
+                        version}
+                while len(self._version_stats) > VERSION_STATS_DEPTH:
+                    victim = next(
+                        (v for v in self._version_stats
+                         if v not in keep),
+                        None,
+                    )
+                    if victim is None:
+                        break  # everything is load-bearing: grow
+                    del self._version_stats[victim]
+            rec["requests"] += 1
+            if is_error:
+                rec["errors"] += 1
+            rec["hist"].add(latency_s)
 
     def notify_replica_death(self, idx_or_rid, exit_code=None):
         """Watchdog ``on_death`` hook: quarantine the replica NOW
@@ -363,6 +501,10 @@ class ServeGateway:
         rep.pending_live = 0
         rep.queued = 0
         rep.live = 0
+        # the respawned process starts with NO adopted snapshot: until
+        # a scrape reports its (re-synced) version, canary routing must
+        # not treat it as caught up
+        rep.weight_version = None
         for lease in self._leases.values():
             if lease.rid == rep.id:
                 # kept (marked) rather than dropped so the episode's
@@ -439,6 +581,7 @@ class ServeGateway:
         rep.live = int(reply.get("live_episodes", 0))
         rep.pending_live = 0  # the scrape's live count subsumes it
         rep.pid = pid
+        rep.weight_version = reply.get("weight_version")
         caps = reply.get("hello")
         if isinstance(caps, dict):
             rep.caps = caps
@@ -561,7 +704,22 @@ class ServeGateway:
             "leases": len(self._leases),
             "routes_inflight": len(self._routes),
             "counters": self.counters.snapshot(),
+            "weights": self._weights_snapshot(),
             "pid": os.getpid(),
+        }
+
+    def _weights_snapshot(self):
+        """The rollout state one dict deep: canary window, stable /
+        rejected versions, per-replica versions, per-version metrics."""
+        return {
+            "canary_version": self._canary_version,
+            "canary_fraction": self._canary_fraction,
+            "stable_version": self._stable_version,
+            "rejected_version": self._rejected_version,
+            "fleet_versions": self.fleet_versions(),
+            "version_stats": {
+                str(v): rec for v, rec in self.version_stats().items()
+            },
         }
 
     def _cmd_telemetry(self, msg):
@@ -575,7 +733,26 @@ class ServeGateway:
             "stages": self.timer.snapshot_serialized(),
             "replicas": {r.id: r.snapshot()
                          for r in self._replicas.values()},
+            "weights": self._weights_snapshot(),
         }
+
+    def _cmd_canary(self, msg):
+        version = msg.get("version")
+        if version is None:
+            return {"error": "canary needs a version"}
+        v = self.canary(version, float(msg.get("fraction", 0.25)))
+        return {"canary_version": v,
+                "fraction": self._canary_fraction}
+
+    def _cmd_promote(self, msg):
+        promoted = self.promote()
+        return {"promoted": promoted,
+                "stable_version": self._stable_version}
+
+    def _cmd_rollback(self, msg):
+        rolled = self.rollback()
+        return {"rolled_back": rolled,
+                "rejected_version": self._rejected_version}
 
     def _cmd_drain(self, msg):
         return self._drain_cmd(msg, True)
@@ -601,7 +778,15 @@ class ServeGateway:
         to the ROTATION candidate (eligible replicas are ranked in
         rotation order and ``min`` keeps the first on equal scores), so
         equal-load fleets round-robin instead of pinning to the
-        lowest-sorting replica id."""
+        lowest-sorting replica id.
+
+        Weight-bus overlays (docs/weight_bus.md): a ROLLED-BACK
+        version's replicas are avoided while any alternative exists,
+        and an open canary window splits fresh episodes between the
+        canary version's replicas (``_canary_fraction`` of them, paced
+        deterministically) and other KNOWN-version replicas — a replica
+        at no known version (respawned, not yet caught up to the bus)
+        gets nothing until a scrape shows it synced."""
         n = len(self._order)
         eligible = []  # in rotation order starting at the pointer
         for k in range(n):
@@ -611,6 +796,36 @@ class ServeGateway:
         if not eligible:
             return None
         self._rr = (self._rr + 1) % n
+        if self._rejected_version is not None:
+            safe = [r for r in eligible
+                    if r.weight_version != self._rejected_version]
+            if safe:
+                # availability first: with NOWHERE else to go, the
+                # rejected version still serves rather than refusing
+                eligible = safe
+        if self._canary_version is not None:
+            can = [r for r in eligible
+                   if r.weight_version == self._canary_version]
+            rest = [r for r in eligible
+                    if r.weight_version is not None
+                    and r.weight_version != self._canary_version]
+            if can and rest:
+                self._canary_acc += self._canary_fraction
+                if self._canary_acc >= 1.0:
+                    self._canary_acc -= 1.0
+                    eligible = can
+                    self.counters.incr("weight_canary_routes")
+                else:
+                    eligible = rest
+            elif can or rest:
+                # only one side exists (the whole fleet converged, or
+                # nothing has): no split to pace — but unknown-version
+                # replicas stay excluded until they catch up
+                if can:
+                    self.counters.incr("weight_canary_routes")
+                eligible = can or rest
+            # neither side known: fall through ungated (a pre-bus
+            # fleet must keep serving under an accidental canary)
         cand = eligible[0]
         chosen = min(eligible, key=lambda r: r.load_score())
         if chosen is not cand:
@@ -897,6 +1112,13 @@ class ServeGateway:
             return
         del self._routes[mid]
         reply["replica"] = rep.id
+        wv = reply.get("weight_version")
+        if wv is not None:
+            # per-version rollout metrics: every stamped reply lands in
+            # its version's request/error/latency record — what the
+            # canary controller's promote/rollback verdicts read
+            self._note_version_reply(wv, "error" in reply,
+                                     time.perf_counter() - route.t0)
         if "error" in reply:
             # name the replica in the traceback the client will raise
             reply["error"] = f"replica {rep.id}: {reply['error']}"

@@ -132,6 +132,29 @@ def drain_socket(recv, handle, counters, who, what):
                              who, what)
 
 
+def _check_tree_like(cur, new, what):
+    """WeightBus apply guard: a snapshot must match the served params'
+    STRUCTURE and per-leaf shapes before it replaces them — adopting a
+    drifted tree would destroy the last good weights and leave every
+    subsequent jitted call failing, the exact outage the 'refused
+    snapshots keep serving the last good version' contract forbids."""
+    import jax
+
+    cur_leaves, cur_def = jax.tree.flatten(cur)
+    new_leaves, new_def = jax.tree.flatten(new)
+    if cur_def != new_def:
+        raise ValueError(
+            f"published {what} snapshot structure does not match the "
+            f"served params ({new_def} != {cur_def})"
+        )
+    for c, n in zip(cur_leaves, new_leaves):
+        if tuple(np.shape(c)) != tuple(np.shape(n)):
+            raise ValueError(
+                f"published {what} snapshot leaf shape {np.shape(n)} "
+                f"!= served {np.shape(c)}"
+            )
+
+
 def default_buckets(max_batch):
     """Powers of two up to ``max_batch`` (inclusive as the cap): each
     bucket is one XLA compilation, so requests pad to the next bucket
@@ -182,6 +205,18 @@ class LinearModel:
         self.pos = np.zeros(self.slots + 1, np.int64)
         self.pad_slot = self.slots
 
+    def apply_weights(self, tree):
+        """WeightBus hot-swap: replace ``w`` from a published
+        ``{"w": (obs_dim, out_dim)}`` tree.  Positions (the per-slot
+        KV-cache stand-in) are untouched — live episodes continue at
+        their timestep under the new weights."""
+        w = np.asarray(tree["w"], np.float32)
+        if w.shape != self.w.shape:
+            raise ValueError(
+                f"published w shape {w.shape} != served {self.w.shape}"
+            )
+        self.w = w
+
     def reset_rows(self, idx):
         self.pos[idx] = 0
 
@@ -229,6 +264,23 @@ class PolicyModel:
         self.obs_dim = int(obs_dim)
         self.int8 = bool(int8)
         self._logits = jax.jit(policy.logits)
+
+    def apply_weights(self, tree):
+        """WeightBus hot-swap: adopt a published policy pytree (float,
+        or ``quantize_policy`` output when this server is ``--int8`` —
+        ``policy.logits`` dispatches per weight dict either way)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.int8 and not any(
+            "w_q" in lay for lay in tree.get("layers", [{}])
+        ):
+            raise ValueError(
+                "int8 policy server got a float snapshot; publish with "
+                "quantize='policy' (or serve float)"
+            )
+        _check_tree_like(self.params, tree, "policy")
+        self.params = jax.tree.map(jnp.asarray, tree)
 
     def reset_rows(self, idx):
         pass
@@ -368,6 +420,28 @@ class SeqFormerModel:
         )
         return np.asarray(pred)
 
+    def apply_weights(self, tree):
+        """WeightBus hot-swap: adopt a published seqformer pytree (the
+        precision this server was built for — float, or
+        ``quantize_seqformer`` output under ``--int8``).  The KV-cache
+        slot pool is untouched: live episodes keep their rows, leases
+        and positions, and the next tick decodes them under the new
+        weights (the standard online-learning semantics — the cache
+        holds the OLD weights' keys/values until positions ring past
+        them, exactly as a learner's own rollout cache would)."""
+        import jax
+        import jax.numpy as jnp
+
+        emb = tree.get("embed", {})
+        if self.int8 != ("w_q" in emb):
+            raise ValueError(
+                "published snapshot precision (int8=%s) != served "
+                "precision (int8=%s); align the publisher's quantize= "
+                "with the server's --int8" % ("w_q" in emb, self.int8)
+            )
+        _check_tree_like(self.params, tree, "seqformer")
+        self.params = jax.tree.map(jnp.asarray, tree)
+
     def reset_rows(self, idx):
         # rewinding pos to 0 is sufficient: _attn_one masks by each
         # slot's absolute position, so the stale k/v rows of the slot's
@@ -408,7 +482,8 @@ class _ModelState:
     episode registry) — multi-model servers keep one per model id, so
     one model's slot exhaustion can never deny another's resets."""
 
-    __slots__ = ("mid", "model", "free", "live", "stateless_eps")
+    __slots__ = ("mid", "model", "free", "live", "stateless_eps",
+                 "weight_version")
 
     def __init__(self, mid, model):
         self.mid = mid
@@ -418,6 +493,11 @@ class _ModelState:
         self.live = {}
         # stateless: episode id -> monotonic last-use
         self.stateless_eps = {}
+        # WeightBus version THIS model serves (None until its first
+        # adopted snapshot) — replies are stamped per executing model,
+        # so a co-hosted model the bus never updated is not reported
+        # at another model's version
+        self.weight_version = None
 
 
 class PolicyServer:
@@ -458,12 +538,21 @@ class PolicyServer:
         Idle-slot eviction horizon: a ``reset`` finding no free slot
         reclaims slots idle longer than this (None = never evict, the
         reset is denied instead).
+    subscriber: blendjax.weights.WeightSubscriber | None
+        WeightBus subscription (docs/weight_bus.md): polled from the
+        serve loop — a complete, digest-verified snapshot is staged
+        off-tick and hot-swapped into the hosted model **between
+        ticks** (KV-cache slots, leases and in-flight exactly-once
+        retries survive; a torn snapshot is discarded and the last
+        good version keeps serving).  Every reply is stamped with
+        ``weight_version`` once a snapshot has been adopted.
     """
 
     def __init__(self, address, model, *, serial=False, tick_ms=2.0,
                  max_batch=64, buckets=None, slot_ttl_s=None,
                  reply_cache_depth=REPLY_CACHE_DEPTH, counters=None,
-                 timer=None, context=None, shm_base=None):
+                 timer=None, context=None, shm_base=None,
+                 subscriber=None):
         import zmq
 
         if isinstance(model, dict):
@@ -537,6 +626,20 @@ class PolicyServer:
         self._poller.register(self._sock, zmq.POLLIN)
         if self._shm is not None and self._shm.fd is not None:
             self._poller.register(self._shm.fd, zmq.POLLIN)
+        #: WeightBus subscription (None = static weights) and the
+        #: version every reply is stamped with after the first adopted
+        #: snapshot (None until then, so a bus-less server's replies
+        #: stay byte-identical to pre-bus servers)
+        self.subscriber = subscriber
+        self.weight_version = None
+        if subscriber is not None:
+            # inherit the server's telemetry sinks unless the caller
+            # wired its own, and wake the serve loop for pushed chunks
+            if subscriber.counters is None:
+                subscriber.counters = self.counters
+            if subscriber.timer is None:
+                subscriber.timer = self.timer
+            self._poller.register(subscriber.sock, zmq.POLLIN)
 
     @property
     def shm_endpoint(self):
@@ -734,6 +837,7 @@ class PolicyServer:
             "queued": len(self._queue),
             "serial": self.serial,
             "models": list(self._models),
+            "weight_version": self.weight_version,
             "per_model": {
                 s.mid: {
                     "slots": s.model.slots,
@@ -766,6 +870,9 @@ class PolicyServer:
             "models": list(self._models),
             "queued": len(self._queue),
             "live_episodes": self._live_episodes(),
+            # the gateway's canary router learns per-replica versions
+            # from this field on its cached scrape (docs/weight_bus.md)
+            "weight_version": self.weight_version,
             "hello": {
                 "model": st.model.kind,
                 "obs_dim": st.model.obs_dim,
@@ -795,8 +902,66 @@ class PolicyServer:
             self.counters.incr("serve_errors")
         return reply
 
+    def _poll_weights(self):
+        """Drain the WeightBus subscription and hot-swap a staged
+        snapshot — called from the serve loop BETWEEN ticks, the one
+        point where no batch is in flight, so slots/leases/reply-cache
+        state cannot be half-stepped under a swap.  A snapshot the
+        model refuses (structure/shape drift) is discarded and counted;
+        the last good version keeps serving either way."""
+        if self.subscriber is None:
+            return
+        snap = self.subscriber.poll()
+        if snap is None:
+            return
+        # routing: the snapshot's own model id wins; a publisher that
+        # does not stamp one (a learner publishing its only model)
+        # targets the model the SUBSCRIBER was attached for, default
+        # model last
+        target = (snap.model if snap.model is not None
+                  else self.subscriber.model
+                  if self.subscriber.model is not None
+                  else self._default_id)
+        st = self._models.get(target)
+        t0 = time.perf_counter()
+        try:
+            if st is None:
+                raise KeyError(
+                    f"snapshot for unhosted model {target!r} "
+                    f"(hosted: {sorted(self._models)})"
+                )
+            st.model.apply_weights(snap.tree())
+        except Exception as exc:  # noqa: BLE001 - keep serving last good
+            self.counters.incr("weight_apply_failed")
+            logger.warning(
+                "policy server: weight snapshot v%d refused (%s: %s); "
+                "still serving v%s", snap.version, type(exc).__name__,
+                exc, self.weight_version,
+            )
+            return
+        st.weight_version = snap.version
+        # the server-level scalar (telemetry/stats — what the gateway
+        # scrapes a replica's rollout progress from) tracks the latest
+        # adopted snapshot; per-reply stamps come from the EXECUTING
+        # model's own version in _finish
+        self.weight_version = snap.version
+        self.counters.incr("weight_adopted")
+        self.timer.add("weight_swap", time.perf_counter() - t0)
+        logger.info("policy server: weights v%d hot-swapped (step %d)",
+                    snap.version, snap.step)
+
     def _finish(self, ident, msg, reply, *, span_name, t0_us):
-        """Stamp correlation id + span, cache mutating replies, send."""
+        """Stamp correlation id + span + weight version, cache mutating
+        replies, send."""
+        st = self._models.get(msg.get("model") or self._default_id)
+        if st is not None and st.weight_version is not None:
+            # the EXECUTING model's version (a co-hosted model the bus
+            # never updated stays unstamped rather than riding another
+            # model's version), stamped BEFORE the reply cache below,
+            # so a retry answered from the cache reports the version
+            # that actually executed it — not the version serving at
+            # retry time
+            reply["weight_version"] = st.weight_version
         mid = msg.get(wire.BTMID_KEY)
         span_ctx = msg.get(wire.SPAN_KEY)
         if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
@@ -1076,6 +1241,9 @@ class PolicyServer:
             return
         while stop_event is None or not stop_event.is_set():
             try:
+                # between ticks: the hot-swap point (no batch in
+                # flight, every queued entry still un-executed)
+                self._poll_weights()
                 if not self._queue:
                     self._poller.poll(poll_ms)
                     self._drain()
@@ -1117,6 +1285,7 @@ class PolicyServer:
         while stop_event is None or not stop_event.is_set():
             try:
                 events = dict(self._poller.poll(poll_ms))
+                self._poll_weights()  # between (batch-1) ticks
                 self._drain_shm()  # ticks per message (serial handler)
                 if self._sock not in events:
                     continue
@@ -1156,6 +1325,11 @@ class PolicyServer:
             self._sock.close(0)
         except Exception:  # noqa: BLE001 - shutdown best-effort
             pass
+        if self.subscriber is not None:
+            try:
+                self.subscriber.close()
+            except Exception:  # noqa: BLE001
+                pass
         if self._shm is not None:
             try:
                 self._shm.close(unlink=True)
@@ -1231,7 +1405,7 @@ class ServerProcess:
     def __init__(self, *, model="linear", address=None, seed=0,
                  obs_dim=8, slots=16, length=64, window=None,
                  num_actions=4, int8=False, serial=False, tick_ms=2.0,
-                 max_batch=64, work_us=0, python=None,
+                 max_batch=64, work_us=0, subscribe=None, python=None,
                  ready_timeout=60.0, extra_args=()):
         from blendjax.replay.shard_client import free_port
 
@@ -1259,6 +1433,8 @@ class ServerProcess:
             self._cmd += ["--shm-base", self.shm_base]
         if work_us:
             self._cmd += ["--work-us", str(work_us)]
+        if subscribe:
+            self._cmd += ["--subscribe", subscribe]
         if window is not None:
             self._cmd += ["--window", str(window)]
         if int8:
@@ -1474,6 +1650,10 @@ def main(argv=None):
     ap.add_argument("--work-us", type=float, default=0,
                     help="linear model only: sleep-based per-row "
                          "compute stand-in (gateway scale-out bench)")
+    ap.add_argument("--subscribe", default=None,
+                    help="WeightBus publisher address to subscribe to "
+                         "(docs/weight_bus.md): published snapshots "
+                         "hot-swap into the served model between ticks")
     ap.add_argument("--shm-base", default=None,
                     help="/dev/shm name prefix for the ShmRPC transport "
                          "(supervising parents pass one so they can "
@@ -1500,10 +1680,15 @@ def main(argv=None):
             models[name] = build_model(args, kind=kind,
                                        seed=args.seed + 1 + i)
         model = models
+    subscriber = None
+    if args.subscribe:
+        from blendjax.weights.bus import WeightSubscriber
+
+        subscriber = WeightSubscriber(args.subscribe)
     server = PolicyServer(
         args.address, model, serial=args.serial,
         tick_ms=args.tick_ms, max_batch=args.max_batch,
-        shm_base=args.shm_base,
+        shm_base=args.shm_base, subscriber=subscriber,
     )
     stop = threading.Event()
 

@@ -201,6 +201,51 @@ GATEWAY_EVENTS = (
     "gateway_stale_lease_redirects", "gateway_drains",
 )
 
+#: Canonical weight-bus event names (see docs/weight_bus.md).  Same
+#: contract as ``FLEET_EVENTS``: any ``EventCounters`` accepts them and
+#: the TelemetryHub zero-fills every name in every scrape.
+#: ``weight_published`` — versioned snapshots streamed by a publisher
+#: (rollback republishes included);
+#: ``weight_publish_bytes`` — snapshot payload bytes streamed (summed
+#: over subscribers; deltas ship only changed leaves);
+#: ``weight_syncs`` — full-snapshot catch-ups served to late joiners /
+#: re-syncing subscribers;
+#: ``weight_adopted`` — complete, digest-verified snapshots hot-swapped
+#: into a serving model between ticks;
+#: ``weight_torn_discarded`` — partial snapshot streams discarded
+#: (publisher died mid-stream, a superseding begin, a sequence gap, an
+#: undecodable frame) — the server keeps serving the last good version;
+#: ``weight_digest_rejected`` — completed streams rejected on checksum
+#: mismatch (whole-stream or per-leaf), never half-applied;
+#: ``weight_apply_failed`` — verified snapshots the model refused
+#: (structure/shape mismatch); the last good version keeps serving;
+#: ``weight_canary_starts`` — canary windows opened on a gateway;
+#: ``weight_canary_routes`` — fresh episodes deliberately routed to the
+#: canary version's replicas;
+#: ``weight_canary_promotions`` — canary versions promoted to stable;
+#: ``weight_canary_rollbacks`` — canary versions rolled back (fresh
+#: traffic stops routing to them);
+#: ``weight_rollback_publishes`` — rollback republishes: a prior
+#: version's weights re-published under a fresh higher version id.
+WEIGHT_EVENTS = (
+    "weight_published", "weight_publish_bytes", "weight_syncs",
+    "weight_adopted", "weight_torn_discarded", "weight_digest_rejected",
+    "weight_apply_failed",
+    "weight_canary_starts", "weight_canary_routes",
+    "weight_canary_promotions", "weight_canary_rollbacks",
+    "weight_rollback_publishes",
+)
+
+#: Canonical weight-bus stage names (see docs/weight_bus.md):
+#: ``weight_publish`` (snapshot + digest + chunk + stream, publisher
+#: side), ``weight_assemble`` (chunk ingest + digest verification per
+#: completed snapshot, subscriber side — compute only, not wall wait),
+#: ``weight_swap`` (the between-ticks hot-swap: pytree rebuild +
+#: ``model.apply_weights``).
+WEIGHT_STAGES = (
+    "weight_publish", "weight_assemble", "weight_swap",
+)
+
 #: Canonical serve-gateway stage names (see docs/serving.md), the
 #: :class:`StageTimer` vocabulary :class:`~blendjax.serve.gateway.
 #: ServeGateway` reports under: ``gw_route`` (request decode + routing

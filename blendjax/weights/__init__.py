@@ -1,0 +1,54 @@
+"""WeightBus (docs/weight_bus.md): live versioned weight publication
+from the learner to the serve tier — the flywheel's connective tissue.
+
+:class:`~blendjax.weights.bus.WeightPublisher` snapshots parameter
+pytrees into versioned, checksummed, chunked snapshots (quantized for
+the wire when configured) and streams them to any number of
+:class:`~blendjax.weights.bus.WeightSubscriber` halves, which
+:class:`~blendjax.serve.server.PolicyServer` polls from its tick loop
+and hot-swaps **between ticks** — KV-cache slots, episode leases and
+in-flight exactly-once retries all survive the swap, and a torn or
+digest-mismatched snapshot is discarded, never half-applied.  The
+:class:`~blendjax.serve.gateway.ServeGateway` layers canary routing by
+lease on top, and :class:`~blendjax.weights.controller.
+WeightBusController` automates promote-after-healthy-window /
+rollback-on-regression from the per-version metrics.
+
+Public surface::
+
+    from blendjax.weights import (
+        WeightPublisher, WeightSubscriber, WeightBusController,
+        Snapshot, SnapshotAssembler,
+    )
+
+Imports stay lazy (PEP 562) so the jax-free server process pays only
+for what it touches.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "WeightPublisher": "blendjax.weights.bus",
+    "WeightSubscriber": "blendjax.weights.bus",
+    "linear_tree": "blendjax.weights.bus",
+    "WeightBusController": "blendjax.weights.controller",
+    "Snapshot": "blendjax.weights.snapshot",
+    "SnapshotAssembler": "blendjax.weights.snapshot",
+    "flatten_tree": "blendjax.weights.snapshot",
+    "unflatten_tree": "blendjax.weights.snapshot",
+    "snapshot_messages": "blendjax.weights.snapshot",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

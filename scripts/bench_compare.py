@@ -62,6 +62,9 @@ DEFAULT_FLOORS = {
     "serve_prefill_x": 0.80,        # batched prefill admission vs serial
     "gateway_qps": 0.80,            # serve-fleet aggregate through the gateway
     "gateway_scale_x": 0.80,        # QPS at N replicas over 1 (drained fleet)
+    # live weight rollouts must stay ~free for serving traffic: QPS in
+    # the buckets around a hot-swap over steady state (docs/weight_bus.md)
+    "weight_swap_qps_dip_x": 0.80,
 }
 
 #: metric -> maximum acceptable new/old ratio for LOWER-is-better
@@ -70,6 +73,10 @@ DEFAULT_FLOORS = {
 DEFAULT_CEILINGS = {
     "serve_p99_ms": 1.30,           # tail latency; loopback-noise slack
     "gateway_p99_ms": 1.30,         # fleet tail latency through the gateway
+    # publish -> first-serving-reply-at-new-version p99: a single-digit
+    # millisecond tail measured over ~8 swaps, so the noise slack is
+    # wider than the steady p99 ceilings
+    "weight_swap_ms": 1.50,
 }
 
 #: fallback floor for numeric metrics named via --metrics that have no
@@ -134,6 +141,12 @@ def _flatten(doc, metrics):
             if isinstance(gb.get(k), (int, float)) \
                     and not isinstance(gb.get(k), bool):
                 metrics[k] = float(gb[k])
+    wb = doc.get("weight_bench")
+    if isinstance(wb, dict):
+        for k in ("weight_swap_ms", "weight_swap_qps_dip_x"):
+            if isinstance(wb.get(k), (int, float)) \
+                    and not isinstance(wb.get(k), bool):
+                metrics[k] = float(wb[k])
 
 
 def _regex_salvage(text, metrics):

@@ -42,6 +42,8 @@ from blendjax.utils.timing import (
     REPLAY_STAGES,
     SERVE_EVENTS,
     SERVE_STAGES,
+    WEIGHT_EVENTS,
+    WEIGHT_STAGES,
     EventCounters,
     StageTimer,
 )
@@ -208,10 +210,10 @@ def test_scrape_zero_fill_contract():
     hub.register("fresh", counters=EventCounters(), timer=StageTimer())
     snap = hub.scrape()
     for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS \
-            + GATEWAY_EVENTS:
+            + GATEWAY_EVENTS + WEIGHT_EVENTS:
         assert snap["counters"][name] == 0, name
     for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES \
-            + GATEWAY_STAGES:
+            + GATEWAY_STAGES + WEIGHT_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
@@ -219,6 +221,9 @@ def test_scrape_zero_fill_contract():
     prom = hub.to_prometheus(snap)
     assert 'blendjax_events_total{event="quarantines"} 0' in prom
     assert 'blendjax_events_total{event="serve_cache_hits"} 0' in prom
+    assert 'blendjax_events_total{event="weight_adopted"} 0' in prom
+    assert ('blendjax_stage_latency_seconds{stage="weight_swap",'
+            'quantile="0.99"} 0') in prom
     assert ('blendjax_stage_latency_seconds{stage="shard_gather",'
             'quantile="0.99"} 0') in prom
     assert ('blendjax_stage_latency_seconds{stage="queue_wait",'
@@ -740,6 +745,34 @@ def test_documented_gateway_stages_exist_in_tuples():
         "## Gateway stage vocabulary",
     )
     vocab = set(GATEWAY_STAGES)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_weight_counters_exist_in_tuples():
+    """The weight-bus vocabulary lock (ISSUE-13 satellite): every
+    ``WEIGHT_EVENTS`` counter docs/weight_bus.md tabulates exists in
+    the tuple and every tuple name is tabulated — both directions,
+    same contract as the other vocabularies."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "weight_bus.md"),
+        "## Counter vocabulary",
+    )
+    vocab = set(WEIGHT_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_weight_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "weight_bus.md"),
+        "## Stage vocabulary",
+    )
+    vocab = set(WEIGHT_STAGES)
     missing = [n for n in names if n not in vocab]
     assert not missing, f"documented but not in tuples: {missing}"
     absent = [n for n in vocab if n not in set(names)]
