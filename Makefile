@@ -11,7 +11,8 @@ SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
 	rlbench rlbench-sharded replaybench shmbench servebench \
-	gatewaybench weightbench multichip dryrun benchdiff obsdemo
+	gatewaybench weightbench scenariobench multichip dryrun benchdiff \
+	obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -182,6 +183,19 @@ gatewaybench:
 weightbench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/weight_benchmark.py \
 		--seconds 10 --clients 6
+
+# Scenario-plane microbench (docs/scenarios.md): a 2-scenario
+# fake-Blender fleet at very different physics rates (lite 200 us vs
+# rich 4 ms), lock-step homogeneous batching vs ready-first
+# step_wait(min_ready=1) over the SAME fleet in interleaved window
+# pairs -> scenario_hetero_x (the throughput the slow scenario no
+# longer steals); then the batched serve tier under a weighted
+# labelled traffic mix -> serve_mix_p99_ms (the union tail a realistic
+# multi-scenario workload observes).  Jax-free; both numbers carried
+# in the bench headline with bench_compare bounds.
+scenariobench:
+	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/scenario_benchmark.py \
+		--seconds 20 --instances 2 --clients 6
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

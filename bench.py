@@ -417,11 +417,33 @@ def main():
         )
         weight_bench = wb_lines[-1] if wb_lines else None
 
+    # eighth configuration: the scenario plane (docs/scenarios.md) —
+    # a 2-scenario heterogeneous fleet stepped ready-first vs the
+    # lock-step homogeneous batch path (scenario_hetero_x), plus the
+    # batched serve tier under a labelled multi-scenario traffic mix
+    # (serve_mix_p99_ms).  Jax-free.
+    scenario_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 30:
+        sc_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "scenario_benchmark.py"),
+                "--seconds", "18",
+                "--instances", "2",
+                "--clients", "6",
+            ],
+            rl_env,
+            min(75, remaining),
+        )
+        scenario_bench = sc_lines[-1] if sc_lines else None
+
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
                    replay_bench=replay_bench, rl_sharded=rl_sharded,
                    serve_bench=serve_bench, gateway_bench=gateway_bench,
-                   weight_bench=weight_bench)
+                   weight_bench=weight_bench,
+                   scenario_bench=scenario_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -465,6 +487,7 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
+    ("scenario_hetero_x", "serve_mix_p99_ms"),
     ("weight_swap_ms", "weight_swap_qps_dip_x"),
     ("serve_int8_x",),
     ("serve_prefill_x",),
@@ -558,6 +581,14 @@ def headline(out):
         line["weight_swap_ms"] = wb["weight_swap_ms"]
         if wb.get("weight_swap_qps_dip_x") is not None:
             line["weight_swap_qps_dip_x"] = wb["weight_swap_qps_dip_x"]
+    sc = out.get("scenario_bench")
+    if sc and sc.get("scenario_hetero_x") is not None:
+        # the scenario-plane headline: heterogeneous-fleet throughput
+        # over the lock-step homogeneous batch path, and the serve
+        # tier's union p99 under a labelled multi-scenario traffic mix
+        line["scenario_hetero_x"] = sc["scenario_hetero_x"]
+        if sc.get("serve_mix_p99_ms") is not None:
+            line["serve_mix_p99_ms"] = sc["serve_mix_p99_ms"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -611,7 +642,7 @@ def headline(out):
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
              rl_sharded=None, serve_bench=None, gateway_bench=None,
-             weight_bench=None):
+             weight_bench=None, scenario_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -645,6 +676,21 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
                 "pair_ratios", "gateway_counters", "stages",
             )
             if k in gateway_bench
+        }
+    if scenario_bench and scenario_bench.get("phase") == "scenario_bench":
+        # the scenario-plane record: heterogeneous-fleet ready-first
+        # vs lock-step, plus the labelled serve traffic mix — see
+        # benchmarks/scenario_benchmark.py
+        extras["scenario_bench"] = {
+            k: scenario_bench[k]
+            for k in (
+                "scenarios", "instances", "rounds", "window_s",
+                "physics_us", "lockstep_steps_per_sec",
+                "hetero_steps_per_sec", "scenario_hetero_x",
+                "pair_ratios", "per_scenario_steps",
+                "scenario_counters", "serve_mix", "serve_mix_p99_ms",
+            )
+            if k in scenario_bench
         }
     if weight_bench and weight_bench.get("phase") == "weight_bench":
         # the live-rollout cost record: publish -> first-serving-reply

@@ -114,6 +114,42 @@ WEIGHT_BENCH_KEYS = (
 )
 
 
+#: Result-schema keys every ``serve_benchmark.py --scenario-mix`` JSON
+#: line carries (phase ``serve_mix_bench``); locked by
+#: ``tests/test_scenario.py``.  ``serve_mix_p99_ms`` is the headline:
+#: the client-observed UNION p99 under a weighted, labelled
+#: multi-scenario traffic mix (per-scenario shapes in ``mix``, the
+#: per-label QPS/p50/p99 breakdown in ``per_scenario``) — the tail a
+#: realistic workload observes, not one synthetic client shape.
+SERVE_MIX_KEYS = (
+    "model", "clients", "rounds", "window_s", "mix",
+    "serve_mix_qps", "serve_mix_p50_ms", "serve_mix_p99_ms",
+    "per_scenario",
+    "stages",
+)
+
+#: Result-schema keys every ``scenario_benchmark.py`` JSON line carries
+#: (phase ``scenario_bench``); ``bench.py`` keys off these and
+#: ``tests/test_scenario.py`` locks emission against this tuple.
+#: ``scenario_hetero_x`` is the headline: aggregate env-steps/sec of a
+#: heterogeneous 2-scenario fleet (fast + slow physics rates) stepped
+#: ready-first (``step_wait(min_ready=1)``) over the SAME fleet
+#: stepped through the homogeneous lock-step batch path (every step
+#: barriers on the slow scenario), median of interleaved window pairs.
+#: The serve-tier half carries the ``serve_mix_*`` record under
+#: ``serve_mix`` (see ``SERVE_MIX_KEYS``).
+SCENARIO_BENCH_KEYS = (
+    "scenarios", "instances", "rounds", "window_s",
+    "hetero_steps_per_sec", "lockstep_steps_per_sec",
+    "scenario_hetero_x",
+    "pair_ratios",
+    "per_scenario_steps",   # hetero-arm env steps per scenario label
+    "scenario_counters",    # scenario_* counter snapshot of the run
+    "serve_mix",            # the SERVE_MIX_KEYS sub-record (or None)
+    "serve_mix_p99_ms",     # hoisted headline (None when mix skipped)
+)
+
+
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)
 

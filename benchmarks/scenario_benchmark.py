@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Scenario-plane microbench: heterogeneous fleets + labelled serve mix.
+
+Two halves, one JSON line (phase ``scenario_bench``, keys locked by
+``benchmarks/_common.SCENARIO_BENCH_KEYS``; see docs/scenarios.md):
+
+**Heterogeneous fleet** — one fake-Blender fleet whose envs split
+between two catalog scenarios at very different physics rates
+(``lite`` at ``--physics-us-fast``, ``rich`` at ``--physics-us-slow``,
+labelled from launch via ``--scenario`` so every reply is stamped).
+Two arms over the SAME fleet, interleaved window pairs:
+
+- **lockstep** — the homogeneous batch path: every ``pool.step``
+  barriers on the slowest env, so the fast scenario runs at the rich
+  scene's frame rate;
+- **hetero** — ready-first pipelining (``step_async`` +
+  ``step_wait(min_ready=1)``): each env is resubmitted the moment its
+  transition lands, so the lite scenario runs at its own rate while
+  the rich one trails — heterogeneous scenario costs no longer stall
+  the batch (Podracer-style throughput, arXiv:2104.06272, only holds
+  at scale if they don't).
+
+``scenario_hetero_x`` = hetero/lockstep aggregate env-steps/sec at the
+median interleaved pair; ``per_scenario_steps`` attributes the hetero
+arm's transitions per scenario from the in-band stamps.
+
+**Serve mix** — ``serve_benchmark.measure_mix``: the batched policy
+server under a weighted, labelled multi-scenario traffic mix;
+``serve_mix_p99_ms`` is the union client-observed p99 (the realistic
+tail, not one synthetic client shape).
+
+Jax-free (EnvPool + linear serve model).  ``make scenariobench``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
+
+def build_catalog(fast_us, slow_us):
+    from blendjax.scenario import ScenarioCatalog, ScenarioSpec
+
+    return ScenarioCatalog([
+        ScenarioSpec("lite", physics_rate_us=int(fast_us),
+                     ranges={"density": (0.1, 0.4)}),
+        ScenarioSpec("rich", physics_rate_us=int(slow_us),
+                     ranges={"density": (0.6, 1.0)}),
+    ])
+
+
+def launch_hetero_pool(catalog, instances_per_scenario, depth,
+                       port_salt=0):
+    """One EnvPool over a 2-scenario fleet: the first half of the envs
+    runs ``lite``, the second half ``rich`` — per-instance launch args
+    from each spec's ``env_kwargs()`` (scenario label + physics rate
+    from the first frame)."""
+    from contextlib import contextmanager
+
+    from blendjax.btt.env import kwargs_to_cli
+    from blendjax.btt.envpool import EnvPool
+    from blendjax.btt.launcher import BlenderLauncher
+
+    os.environ.setdefault(
+        "BLENDJAX_BLENDER",
+        os.path.join(os.path.dirname(HERE), "tests", "helpers",
+                     "fake_blender.py"),
+    )
+    script = os.path.join(
+        os.path.dirname(HERE), "tests", "blender", "env.blend.py"
+    )
+    specs = list(catalog)
+    instance_args = []
+    for spec in specs:
+        kw = dict(spec.env_kwargs())
+        kw["horizon"] = 1_000_000_000
+        for _ in range(instances_per_scenario):
+            instance_args.append(list(kwargs_to_cli(kw)))
+
+    @contextmanager
+    def ctx():
+        with BlenderLauncher(
+            scene="",
+            script=script,
+            num_instances=len(instance_args),
+            named_sockets=["GYM"],
+            instance_args=instance_args,
+            background=True,
+            start_port=22000 + (os.getpid() * 29 + port_salt * 97) % 20000,
+        ) as bl:
+            pool = EnvPool(
+                bl.launch_info.addresses["GYM"], timeoutms=30000,
+                pipeline_depth=depth,
+            )
+            try:
+                yield pool
+            finally:
+                pool.close()
+
+    return ctx()
+
+
+def measure_hetero(seconds=12.0, instances=2, *, fast_us=200,
+                   slow_us=4000, pairs=3, depth=4):
+    """Lockstep vs ready-first over one 2-scenario fleet; returns the
+    hetero half of the scenario_bench record."""
+    catalog = build_catalog(fast_us, slow_us)
+    n_envs = 2 * instances
+    window_s = max(seconds / (2 * pairs), 1.0)
+
+    def lock_window(pool):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < window_s:
+            pool.step([0.5] * n_envs)
+            n += n_envs
+        return n / (time.perf_counter() - t0), {}
+
+    def hetero_window(pool):
+        for _ in range(depth):
+            pool.step_async([0.5] * n_envs)
+        warmed = 0
+        while warmed < 8 * n_envs:  # refill the producers' queues
+            idx, *_ = pool.step_wait(min_ready=1)
+            pool.step_async([0.5] * len(idx), indices=list(idx))
+            warmed += len(idx)
+        per = {}
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < window_s:
+            idx, _obs, _rew, _done, infos = pool.step_wait(min_ready=1)
+            pool.step_async([0.5] * len(idx), indices=list(idx))
+            n += len(idx)
+            for inf in infos:
+                sid = inf.get("scenario", "_unlabelled")
+                per[sid] = per.get(sid, 0) + 1
+        rate = n / (time.perf_counter() - t0)
+        pool.step_wait()  # drain before handing the fleet back
+        return rate, per
+
+    locks, het, ratios = [], [], []
+    per_scenario = {}
+    with launch_hetero_pool(catalog, instances, depth) as pool:
+        pool.reset()
+        for _ in range(8):  # warmup: connect + frame-loop spin-up
+            pool.step([0.5] * n_envs)
+        for _ in range(pairs):
+            lock_rate, _ = lock_window(pool)
+            het_rate, per = hetero_window(pool)
+            locks.append(lock_rate)
+            het.append(het_rate)
+            ratios.append(het_rate / max(lock_rate, 1e-9))
+            for k, v in per.items():
+                per_scenario[k] = per_scenario.get(k, 0) + v
+    med = sorted(ratios)[len(ratios) // 2]
+    return {
+        "scenarios": catalog.names(),
+        "instances": n_envs,
+        "rounds": pairs,
+        "window_s": round(window_s, 3),
+        "physics_us": {"lite": int(fast_us), "rich": int(slow_us)},
+        "pipeline_depth": depth,
+        "lockstep_steps_per_sec": round(
+            sorted(locks)[len(locks) // 2], 1
+        ),
+        "hetero_steps_per_sec": round(sorted(het)[len(het) // 2], 1),
+        "scenario_hetero_x": round(med, 3),
+        "pair_ratios": [round(r, 3) for r in ratios],
+        "per_scenario_steps": per_scenario,
+    }
+
+
+def measure(seconds=18.0, instances=2, clients=6, *, fast_us=200,
+            slow_us=4000, pairs=3, depth=4, mix=None, serve_rounds=2,
+            skip_serve=False):
+    """The full scenario_bench record: hetero fleet + serve mix."""
+    from benchmarks.serve_benchmark import measure_mix
+    from blendjax.utils.timing import fleet_counters
+
+    before = fleet_counters.snapshot()
+    rec = measure_hetero(
+        seconds=seconds * 0.6, instances=instances, fast_us=fast_us,
+        slow_us=slow_us, pairs=pairs, depth=depth,
+    )
+    serve_mix = None
+    if not skip_serve:
+        serve_mix = measure_mix(
+            seconds=seconds * 0.4, clients=clients, model="linear",
+            mix=mix, rounds=serve_rounds,
+        )
+    after = fleet_counters.snapshot()
+    rec["scenario_counters"] = {
+        k: after.get(k, 0) - before.get(k, 0)
+        for k in after
+        if k.startswith("scenario_")
+    }
+    rec["serve_mix"] = serve_mix
+    rec["serve_mix_p99_ms"] = (
+        serve_mix["serve_mix_p99_ms"] if serve_mix else None
+    )
+    return rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seconds", type=float, default=20.0,
+                    help="total timed budget across both halves")
+    ap.add_argument("--instances", type=int, default=2,
+                    help="envs PER SCENARIO (fleet size = 2x this)")
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--physics-us-fast", type=int, default=200)
+    ap.add_argument("--physics-us-slow", type=int, default=4000)
+    ap.add_argument("--pairs", type=int, default=3)
+    ap.add_argument("--pipeline-depth", type=int, default=4)
+    ap.add_argument("--mix", default=None,
+                    help="serve mix spec (see serve_benchmark "
+                         "--scenario-mix)")
+    ap.add_argument("--skip-serve", action="store_true")
+    args = ap.parse_args(argv)
+    rec = measure(
+        seconds=args.seconds, instances=args.instances,
+        clients=args.clients, fast_us=args.physics_us_fast,
+        slow_us=args.physics_us_slow, pairs=args.pairs,
+        depth=args.pipeline_depth, mix=args.mix,
+        skip_serve=args.skip_serve,
+    )
+    line = {
+        "metric": "scenario_hetero_x",
+        "value": rec["scenario_hetero_x"],
+        "unit": "x (ready-first / lock-step env-steps/sec over a "
+                "2-scenario fleet, median interleaved pair)",
+        "phase": "scenario_bench",
+        **rec,
+    }
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,15 @@ union p99.  Replicas serve the linear model with a sleep-based per-row
 ``--work-us`` compute stand-in (the RL bench's ``physics_us`` pattern)
 so replica compute — not the loopback wire — is the bottleneck being
 scaled; keys locked by ``GATEWAY_BENCH_KEYS``.  See docs/serving.md.
+
+``--scenario-mix`` switches to the **labelled traffic mix** arm
+(docs/scenarios.md): the same batched server and the same client loop,
+driven by a weighted set of :class:`RequestProfile` shapes (per-label
+episode length and step cadence) instead of one synthetic shape —
+per-scenario QPS/p99 plus ``serve_mix_p99_ms``, the union tail latency
+a realistic multi-scenario workload observes.  All three arms share
+the one profile-driven client loop; the legacy arms are simply the
+single-profile case.
 """
 
 from __future__ import annotations
@@ -105,9 +114,71 @@ def _warm_buckets(server, clients):
             break
 
 
-def _run_window(address, obs_dim, seconds, clients, episode_len):
-    """One timed window of ``clients`` concurrent episode loops;
-    returns (qps, merged client-observed latency histogram)."""
+class RequestProfile:
+    """One client workload shape — the single-client-shape assumption
+    the legacy arms baked in, factored into an object so the legacy
+    arms and the ``--scenario-mix`` arm share ONE client loop.
+
+    Params
+    ------
+    obs_dim: int
+        Observation width each ``step`` sends.
+    episode_len: int
+        Steps per episode before close+reset (the admission rate).
+    scenario: str | None
+        Traffic label stamped on every admission (``reset(scenario=)``)
+        so a fronting gateway attributes the episode's requests to its
+        per-scenario records; None = unlabelled (the legacy arms).
+    weight: float
+        Share of clients this profile claims in a mix window
+        (largest-remainder apportionment over the client count).
+    think_us: int
+        Client-side pause between steps — a slow-cadence scenario's
+        request shape (0 = closed-loop as fast as replies arrive).
+    """
+
+    __slots__ = ("obs_dim", "episode_len", "scenario", "weight",
+                 "think_us")
+
+    def __init__(self, obs_dim, episode_len, *, scenario=None,
+                 weight=1.0, think_us=0):
+        self.obs_dim = int(obs_dim)
+        self.episode_len = max(1, int(episode_len))
+        self.scenario = scenario
+        self.weight = float(weight)
+        self.think_us = int(think_us)
+
+
+def assign_profiles(profiles, clients):
+    """Per-client profile list from a weighted profile set
+    (largest-remainder over the client count, profile order breaking
+    ties — deterministic).  A single profile fans out to every
+    client."""
+    if isinstance(profiles, RequestProfile):
+        return [profiles] * clients
+    profiles = list(profiles)
+    total = sum(max(p.weight, 0.0) for p in profiles) or 1.0
+    quotas = [max(p.weight, 0.0) / total * clients for p in profiles]
+    counts = [int(q) for q in quotas]
+    order = sorted(
+        range(len(profiles)),
+        key=lambda i: (-(quotas[i] - int(quotas[i])), i),
+    )
+    for i in order[:clients - sum(counts)]:
+        counts[i] += 1
+    out = []
+    for p, k in zip(profiles, counts):
+        out.extend([p] * k)
+    return out[:clients]
+
+
+def _run_window(address, profiles, seconds, clients):
+    """One timed window of ``clients`` concurrent episode loops, each
+    driving its assigned :class:`RequestProfile`; returns ``(qps,
+    merged client-observed latency histogram, per-scenario
+    {label: (count, histogram)})`` — the per-scenario dict is empty
+    for unlabelled (legacy single-shape) windows."""
+    assigned = assign_profiles(profiles, clients)
     hists = [LatencyHistogram() for _ in range(clients)]
     counts = [0] * clients
     # two barriers so the clock starts only once EVERY client is
@@ -124,11 +195,13 @@ def _run_window(address, obs_dim, seconds, clients, episode_len):
     def runner(i):
         from blendjax.serve.client import ServeClient
 
+        prof = assigned[i]
         client = ServeClient(address, timeoutms=10000)
         rng = np.random.default_rng(1000 + i)
-        obs = rng.standard_normal(obs_dim).astype(np.float32)
+        obs = rng.standard_normal(prof.obs_dim).astype(np.float32)
+        think_s = prof.think_us / 1e6
         try:
-            client.reset()
+            client.reset(scenario=prof.scenario)
             ready.wait(timeout=30)
             go.wait(timeout=30)
             end = t_deadline[0]
@@ -139,10 +212,12 @@ def _run_window(address, obs_dim, seconds, clients, episode_len):
                 hists[i].add(time.perf_counter() - t0)
                 n += 1
                 steps += 1
-                if steps >= episode_len:
+                if steps >= prof.episode_len:
                     client.close_episode()
-                    client.reset()
+                    client.reset(scenario=prof.scenario)
                     steps = 0
+                if think_s:
+                    time.sleep(think_s)
             counts[i] = n
         except Exception as exc:  # noqa: BLE001 - must not corrupt qps
             # a dead client thread would silently deflate the window's
@@ -179,7 +254,16 @@ def _run_window(address, obs_dim, seconds, clients, episode_len):
     merged = LatencyHistogram()
     for h in hists:
         merged.merge(h)
-    return sum(counts) / seconds, merged
+    by_scenario = {}
+    for i, prof in enumerate(assigned):
+        if prof.scenario is None:
+            continue
+        cnt, h = by_scenario.setdefault(
+            prof.scenario, [0, LatencyHistogram()]
+        )
+        by_scenario[prof.scenario][0] = cnt + counts[i]
+        h.merge(hists[i])
+    return sum(counts) / seconds, merged, by_scenario
 
 
 def _measure_prefill(address, obs_dim, *, prefix_len=32, admissions=4,
@@ -275,19 +359,19 @@ def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
             q_model, counters=EventCounters(), timer=StageTimer(),
             tick_ms=tick_ms,
         )
+    profile = RequestProfile(obs_dim, episode_len)
     qps = {name: [] for name in servers}
     batched_hist = LatencyHistogram()
     try:
         for name, h in servers.items():
             _warm_buckets(h.server, clients)
-            _run_window(h.address, obs_dim, 0.3, clients, episode_len)
+            _run_window(h.address, profile, 0.3, clients)
         order = list(servers)
         for r in range(rounds):
             rotated = order[r % len(order):] + order[:r % len(order)]
             for name in rotated:
-                rate, hist = _run_window(
-                    servers[name].address, obs_dim, window_s, clients,
-                    episode_len,
+                rate, hist, _ = _run_window(
+                    servers[name].address, profile, window_s, clients,
                 )
                 qps[name].append(rate)
                 if name == "batched":
@@ -355,6 +439,7 @@ def measure_gateway(seconds=18.0, clients=16, replicas=3, *, obs_dim=8,
     slots = slots or max(2 * clients, 16)
     window_s = max(0.5, seconds / (rounds * 2))
     counters, timer = EventCounters(), StageTimer()
+    profile = RequestProfile(obs_dim, episode_len)
     qps_one, qps_all = [], []
     all_hist = LatencyHistogram()
     with ServerFleet(replicas, model="linear", obs_dim=obs_dim,
@@ -373,21 +458,21 @@ def measure_gateway(seconds=18.0, clients=16, replicas=3, *, obs_dim=8,
                 gw.gateway.drain(rid)
             time.sleep(0.05)  # let in-flight resets settle
             try:
-                rate, _ = _run_window(gw.address, obs_dim, window_s,
-                                      clients, episode_len)
+                rate, _, _ = _run_window(gw.address, profile, window_s,
+                                         clients)
             finally:
                 for rid in rest:
                     gw.gateway.undrain(rid)
             return rate
 
         def run_all():
-            rate, hist = _run_window(gw.address, obs_dim, window_s,
-                                     clients, episode_len)
+            rate, hist, _ = _run_window(gw.address, profile, window_s,
+                                        clients)
             all_hist.merge(hist)
             return rate
 
         try:
-            _run_window(gw.address, obs_dim, 0.3, clients, episode_len)
+            _run_window(gw.address, profile, 0.3, clients)
             for r in range(rounds):
                 if r % 2 == 0:
                     qps_one.append(run_one())
@@ -426,6 +511,104 @@ def measure_gateway(seconds=18.0, clients=16, replicas=3, *, obs_dim=8,
     }
 
 
+#: default labelled traffic mix (``label:weight:episode_len:think_us``):
+#: a steady closed-loop majority, a bursty short-episode tail (admission
+#: churn), and a slow-cadence scenario pacing its steps — the
+#: multi-scenario workload the single-shape headline never saw.
+DEFAULT_MIX = "steady:4:32:0,bursty:2:4:0,slow:2:32:3000"
+
+
+def parse_mix(spec, obs_dim):
+    """``label:weight[:episode_len[:think_us]]`` comma list ->
+    :class:`RequestProfile` list."""
+    profiles = []
+    for part in spec.split(","):
+        fields = part.strip().split(":")
+        if not fields or not fields[0]:
+            raise ValueError(f"bad mix entry {part!r}")
+        label = fields[0]
+        weight = float(fields[1]) if len(fields) > 1 else 1.0
+        episode_len = int(fields[2]) if len(fields) > 2 else 32
+        think_us = int(fields[3]) if len(fields) > 3 else 0
+        profiles.append(RequestProfile(
+            obs_dim, episode_len, scenario=label, weight=weight,
+            think_us=think_us,
+        ))
+    return profiles
+
+
+def measure_mix(seconds=12.0, clients=8, model="linear", *, obs_dim=8,
+                mix=None, rounds=3, slots=None, seed=0, tick_ms=1.0,
+                episode_len=32):
+    """The ``--scenario-mix`` arm (docs/scenarios.md): the SAME
+    batched server and the SAME client loop as the legacy arm, driven
+    by a weighted set of labelled :class:`RequestProfile` shapes
+    instead of one — per-scenario QPS/p50/p99 plus the union
+    ``serve_mix_p99_ms`` headline, the tail latency a realistic
+    multi-scenario workload actually observes."""
+    from blendjax.serve.server import start_server_thread
+    from blendjax.utils.timing import EventCounters, StageTimer
+
+    profiles = (mix if isinstance(mix, list)
+                else parse_mix(mix or DEFAULT_MIX, obs_dim))
+    slots = slots or max(2 * clients, 16)
+    window_s = max(0.5, seconds / max(rounds, 1))
+    f_model, _, _ = _build_models(
+        model, obs_dim=obs_dim, d_model=64, n_heads=4, n_layers=2,
+        slots=slots, length=64, seed=seed, int8=False,
+    )
+    timer = StageTimer()
+    handle = start_server_thread(
+        f_model, counters=EventCounters(), timer=timer, tick_ms=tick_ms,
+    )
+    qps_rounds = []
+    union = LatencyHistogram()
+    per = {}  # label -> [count_total, hist]
+    try:
+        _warm_buckets(handle.server, clients)
+        _run_window(handle.address, profiles, 0.3, clients)
+        for _ in range(rounds):
+            rate, hist, by_scen = _run_window(
+                handle.address, profiles, window_s, clients,
+            )
+            qps_rounds.append(rate)
+            union.merge(hist)
+            for label, (cnt, h) in by_scen.items():
+                rec = per.setdefault(label, [0, LatencyHistogram()])
+                rec[0] += cnt
+                rec[1].merge(h)
+    finally:
+        handle.close()
+    pct = union.percentiles()
+    per_scenario = {}
+    for label, (cnt, h) in sorted(per.items()):
+        p = h.percentiles()
+        per_scenario[label] = {
+            "qps": round(cnt / (rounds * window_s), 2),
+            "p50_ms": p["p50_ms"],
+            "p99_ms": p["p99_ms"],
+        }
+    return {
+        "model": model,
+        "clients": clients,
+        "rounds": rounds,
+        "window_s": round(window_s, 3),
+        "mix": [
+            {"scenario": p.scenario, "weight": p.weight,
+             "episode_len": p.episode_len, "think_us": p.think_us}
+            for p in profiles
+        ],
+        "serve_mix_qps": round(float(np.median(qps_rounds)), 2),
+        "serve_mix_p50_ms": pct["p50_ms"],
+        "serve_mix_p99_ms": pct["p99_ms"],
+        "per_scenario": per_scenario,
+        "stages": {
+            k: v for k, v in timer.summary().items()
+            if k in ("queue_wait", "batch_assemble", "compute", "reply")
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seconds", type=float, default=18.0,
@@ -450,7 +633,29 @@ def main(argv=None):
     ap.add_argument("--work-us", type=float, default=2000,
                     help="gateway bench: per-row replica compute "
                          "stand-in (sleep-based, linear model)")
+    ap.add_argument("--scenario-mix", nargs="?", const=DEFAULT_MIX,
+                    default=None, metavar="L:W[:EP[:THINK_US]],...",
+                    help="labelled traffic-mix arm (docs/scenarios.md): "
+                         "weighted request profiles over one batched "
+                         "server; reports per-scenario QPS/p99 and the "
+                         "serve_mix_p99_ms union headline")
     args = ap.parse_args(argv)
+    if args.scenario_mix is not None:
+        rec = measure_mix(
+            seconds=args.seconds, clients=args.clients,
+            model=args.model, obs_dim=args.obs_dim,
+            mix=args.scenario_mix, rounds=args.rounds or 3,
+            slots=args.slots, seed=args.seed,
+        )
+        line = {
+            "metric": "serve_mix_p99_ms",
+            "value": rec["serve_mix_p99_ms"],
+            "unit": "ms",
+            "phase": "serve_mix_bench",
+            **rec,
+        }
+        print(json.dumps(line), flush=True)
+        return 0
     if args.gateway:
         rec = measure_gateway(
             seconds=args.seconds, clients=args.clients,

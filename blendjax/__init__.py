@@ -25,6 +25,9 @@ Subpackages
   of ``step()`` over the DEALER wire, KV-cache slot pools, int8 serving.
 - ``blendjax.obs``     unified telemetry plane: latency histograms,
   cross-process trace spans, TelemetryHub scrapes, flight recorders.
+- ``blendjax.scenario`` scenario plane: named scene catalogs, live
+  domain randomization over the duplex control plane, curriculum
+  scheduling of the fleet's scenario mix.
 - ``blendjax.utils``    timing/tracing, logging.
 
 This module is import-light on purpose: importing :mod:`blendjax` pulls in
@@ -37,7 +40,8 @@ __version__ = "0.1.0"
 from blendjax import wire  # noqa: F401  (pure stdlib + zmq/numpy, always safe)
 
 _SUBMODULES = (
-    "btt", "btb", "models", "obs", "ops", "parallel", "utils", "wire",
+    "btt", "btb", "models", "obs", "ops", "parallel", "scenario",
+    "utils", "wire",
 )
 
 

@@ -103,6 +103,38 @@ class BaseEnv:
         self.renderer = OffScreenRenderer(camera=Camera(), mode="rgb", gamma=True)
         self.render_every = every_nth
 
+    def attach_param_channel(self, channel, apply=None):
+        """Receive mid-training scene-parameter pushes — the densityopt
+        receiver (reference ``examples/densityopt``) as a first-class
+        hook, and the producer half of the scenario plane's live domain
+        randomization (docs/scenarios.md).
+
+        ``channel`` is a producer-side (bound)
+        :class:`blendjax.btb.duplex.DuplexChannel`; every frame, queued
+        messages are drained non-blocking (``recv(timeoutms=0)``) and
+        each is handed to ``apply`` (or the :meth:`_env_apply_params`
+        hook), so a push lands within one frame of arriving and a
+        silent channel costs one poll per frame.  Messages apply BEFORE
+        the next action is integrated (the poll runs ahead of the
+        agent callback in the frame), so a pushed physics rate or scene
+        param takes effect on the very next simulated step."""
+        self.param_channel = channel
+        self._param_apply = apply
+        # ahead of the agent callback registered in __init__: params
+        # must apply before the frame's action is prepared
+        self.events.pre_frame.add_first(self._poll_params)
+
+    def _poll_params(self):
+        chan = getattr(self, "param_channel", None)
+        if chan is None:
+            return
+        while True:
+            msg = chan.recv(timeoutms=0)
+            if msg is None:
+                break
+            fn = getattr(self, "_param_apply", None)
+            (fn or self._env_apply_params)(msg)
+
     # -- animation callbacks ------------------------------------------------
 
     def _pre_animation(self):
@@ -150,6 +182,17 @@ class BaseEnv:
         """Return ``{obs, reward, ...}`` (and optionally ``done``) after the
         frame completed."""
         raise NotImplementedError
+
+    def _env_apply_params(self, msg):
+        """Apply one mid-training parameter push received over the
+        attached duplex channel (:meth:`attach_param_channel`) — a
+        message dict, typically ``{"cmd": "scenario", "scenario":
+        name, "params": {...}}`` from a
+        :class:`~blendjax.scenario.DomainRandomizer`.  Default: no-op,
+        so envs that never randomize pay nothing for the hook; a
+        scenario-aware env overrides it, applies what it understands,
+        and echoes the applied scenario name in its post-step dict so
+        the consumer can attribute transitions (docs/scenarios.md)."""
 
 
 class RemoteControlledAgent:

@@ -27,6 +27,16 @@ class Signal:
         self._slots.append(fn)
         return fn
 
+    def add_first(self, fn, *bound_args, **bound_kwargs):
+        """Register ``fn`` ahead of every existing handler — for hooks
+        that must observe/mutate state before the frame's regular
+        handlers run (e.g. a scenario param push applying before the
+        agent's action is prepared)."""
+        if bound_args or bound_kwargs:
+            fn = functools.partial(fn, *bound_args, **bound_kwargs)
+        self._slots.insert(0, fn)
+        return fn
+
     def remove(self, handle):
         self._slots.remove(handle)
 

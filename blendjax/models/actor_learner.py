@@ -141,6 +141,26 @@ class ActorLearner:
         the serving precision).
     publish_every: int
         Updates between bus publishes (1 = every update).
+    scenarios: blendjax.scenario.DomainRandomizer | None
+        The scenario plane (docs/scenarios.md): transitions are
+        stamped with their env's scenario (the producer's in-band echo,
+        falling back to the fleet's assignment), per-scenario env-step
+        and update counts accumulate (:meth:`stats`), re-admitted envs
+        get their scenario re-pushed, and replay appends carry the
+        stamp into per-scenario strata.  None (the default) changes
+        NOTHING — runs without a scenario plane are byte-identical.
+    curriculum: blendjax.scenario.CurriculumScheduler | None
+        Ticked once per completed update: on its interval it reweights
+        the scenario mix from the replay strata and (when
+        ``scenarios`` is attached) drives the new per-fleet assignment
+        through the randomizer — a curriculum shift is visible from
+        the training loop alone via :meth:`stats`.
+    fanin_min_ready: int | None
+        Heterogeneous-fleet fan-in (multi-fleet only): collect a
+        global batch as soon as this many live fleets contributed a
+        segment (absent fleets zero-masked) instead of barriering on
+        every live fleet — what keeps a slow rich scenario from
+        stalling the learner.  None keeps the all-live barrier.
     """
 
     def __init__(self, pool, obs_dim, num_actions, *, rollout_len=32,
@@ -148,7 +168,8 @@ class ActorLearner:
                  continuous=False, action_map=None, pipeline=False,
                  mesh=None, num_fleets=None,
                  replay=None, replay_ratio=0, replay_batch=64, hub=None,
-                 weight_bus=None, publish_every=1):
+                 weight_bus=None, publish_every=1,
+                 scenarios=None, curriculum=None, fanin_min_ready=None):
         self.pools = _as_pools(pool)
         if num_fleets is not None:
             if self.pools and num_fleets != len(self.pools):
@@ -278,6 +299,20 @@ class ActorLearner:
         )
         self.weight_bus = weight_bus
         self.publish_every = max(1, int(publish_every))
+        #: scenario plane (docs/scenarios.md); None = plane off, and
+        #: every scenario-aware branch below is skipped — plane-off
+        #: runs stay byte-identical to pre-scenario builds
+        self.randomizer = scenarios
+        self.curriculum = curriculum
+        self.fanin_min_ready = (
+            None if fanin_min_ready is None else max(1, int(fanin_min_ready))
+        )
+        nf = max(1, len(self.pools) or (num_fleets or 0) or 1)
+        # per-fleet dicts: each is written by exactly one actor thread
+        self._scenario_steps_by_fleet = [dict() for _ in range(nf)]
+        self._updates_by_scenario = {}   # learner thread only
+        self._pending_group_batches = []  # hetero-shape extras (learner)
+        self._last_update_fleets = ()
         self._updates_done = 0
         self._q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._fanin = None
@@ -305,21 +340,58 @@ class ActorLearner:
                     replay.name, counters=replay.counters,
                     timer=replay.timer, probe=replay.stats,
                 )
-            hub.register(
-                "actor_learner",
-                probe=lambda: {
-                    "env_steps": self._env_steps,
-                    "unhealthy_env_steps": self._unhealthy_env_steps,
-                    "env_steps_by_fleet": list(self._env_steps_by_fleet),
-                    "fleet_restarts": list(self._fleet_restarts),
-                    "dead_fleets": [
-                        fid for fid, e in enumerate(self._actor_errors)
-                        if e is not None
-                    ],
-                },
-            )
+            hub.register("actor_learner", probe=self.stats)
+            # scenario plane components ride the same hub; counters are
+            # deduplicated BY IDENTITY — sharing one EventCounters
+            # across replay/randomizer/curriculum (the common setup)
+            # must not fold the same events twice in the aggregate
+            seen = {id(replay.counters)} if replay is not None else set()
+            for name, comp in (
+                ("scenario_randomizer", self.randomizer),
+                ("scenario_curriculum", self.curriculum),
+            ):
+                if comp is None:
+                    continue
+                dup = id(comp.counters) in seen
+                seen.add(id(comp.counters))
+                hub.register(
+                    name,
+                    counters=None if dup else comp.counters,
+                    timer=comp.timer,
+                    probe=comp.stats,
+                )
 
     # -- aggregate views -----------------------------------------------------
+
+    def stats(self):
+        """Live training-loop accounting, readable mid-run (also the
+        hub probe): fleet/step totals plus — with the scenario plane
+        attached — per-scenario env-step and update counts, the
+        current mix and assignments, so a curriculum shift is visible
+        from the training loop alone (docs/scenarios.md)."""
+        out = {
+            "env_steps": self._env_steps,
+            "unhealthy_env_steps": self._unhealthy_env_steps,
+            "env_steps_by_fleet": list(self._env_steps_by_fleet),
+            "updates": self._updates_done,
+            "fleet_restarts": list(self._fleet_restarts),
+            "dead_fleets": [
+                fid for fid, e in enumerate(self._actor_errors)
+                if e is not None
+            ],
+        }
+        if self.randomizer is not None or self.curriculum is not None:
+            merged = {}
+            for d in self._scenario_steps_by_fleet:
+                for sid, n in list(d.items()):
+                    merged[sid] = merged.get(sid, 0) + n
+            out["env_steps_by_scenario"] = merged
+            out["updates_by_scenario"] = dict(self._updates_by_scenario)
+            if self.randomizer is not None:
+                out["scenario_assignments"] = self.randomizer.assignments
+            if self.curriculum is not None:
+                out["scenario_mix"] = self.curriculum.mix()
+        return out
 
     @property
     def _env_steps(self):
@@ -443,6 +515,27 @@ class ActorLearner:
                         log.warning(
                             "actor rollout healthy again (fleet %d)", fid
                         )
+                    scen = None
+                    if self.randomizer is not None:
+                        # scenario attribution (docs/scenarios.md): the
+                        # producer's in-band echo wins; a synthetic /
+                        # pre-push transition falls back to the fleet's
+                        # assignment.  A re-admitted env gets the
+                        # fleet's scenario re-pushed over a fresh
+                        # channel — the respawned producer must not
+                        # keep serving the default scene.
+                        assigned = self.randomizer.scenario_of(fid)
+                        steps = self._scenario_steps_by_fleet[fid]
+                        scen = []
+                        for i, inf in enumerate(infos):
+                            sid = inf.get("scenario") or assigned
+                            scen.append(sid)
+                            if sid is not None:
+                                steps[sid] = steps.get(sid, 0) + 1
+                            if inf.get("readmitted"):
+                                self.randomizer.reassign(fid, i)
+                            self.randomizer.note_info(fid, inf)
+                        self.randomizer.maybe_resample(fid)
                     seg_obs.append(obs)
                     seg_act.append(action)
                     seg_rew.append(np.asarray(rew, np.float32))
@@ -469,6 +562,10 @@ class ActorLearner:
                             healthy=[
                                 inf.get("healthy", True) for inf in infos
                             ],
+                            # scenario stamps ride in-band into the
+                            # per-scenario replay strata (None when the
+                            # plane is off: appends are byte-identical)
+                            scenarios=scen,
                         )
                     self._env_steps_by_fleet[fid] += pool.num_envs
                 seg_lists = (seg_obs, seg_act, seg_rew, seg_done)
@@ -616,6 +713,46 @@ class ActorLearner:
         return (fid < len(self._threads)
                 and self._threads[fid].is_alive())
 
+    # -- scenario plane ------------------------------------------------------
+
+    def _note_update_scenarios(self):
+        """Attribute one completed on-policy update to the scenarios of
+        its contributing fleets (learner thread only)."""
+        if self.randomizer is None and self.curriculum is None:
+            return
+        fleets = self._last_update_fleets or tuple(
+            range(max(1, len(self.pools)))
+        )
+        for fid in fleets:
+            sid = (self.randomizer.scenario_of(fid)
+                   if self.randomizer is not None else None)
+            key = sid if sid is not None else "_unlabelled"
+            self._updates_by_scenario[key] = \
+                self._updates_by_scenario.get(key, 0) + 1
+
+    def _tick_curriculum(self):
+        """One curriculum tick per completed update: on its interval
+        the scheduler reweights the mix from the replay strata, and a
+        changed mix is driven through the randomizer as a fresh
+        per-fleet assignment (docs/scenarios.md)."""
+        if self.curriculum is None:
+            return
+        stats_fn = None
+        if self.replay is not None \
+                and hasattr(self.replay, "scenario_stats"):
+            stats_fn = self.replay.scenario_stats
+        mix = self.curriculum.tick(stats_fn)
+        if mix is not None and self.randomizer is not None \
+                and self.pools:
+            assignment = self.curriculum.assign(len(self.pools))
+            changed = self.randomizer.apply_assignment(assignment)
+            if changed:
+                log.info(
+                    "curriculum reassigned fleets %s -> %s "
+                    "(mix %s)", changed,
+                    [assignment[f] for f in changed], mix,
+                )
+
     def _maybe_restart_fleets(self):
         """Fleet re-admission: a fleet whose actor thread died (every
         env dead -> the pool raised) rejoins once the supervisor's heal
@@ -666,8 +803,20 @@ class ActorLearner:
 
     def _next_fanin_batch(self, deadline):
         """One pre-sharded global batch from the fan-in, or ``None`` on
-        deadline/stop, or raises once EVERY fleet has failed."""
+        deadline/stop, or raises once EVERY fleet has failed.
+
+        With a heterogeneous fleet set, one collect can yield SEVERAL
+        shape groups (:meth:`SegmentFanIn.assemble_groups`): the first
+        is returned now and the rest queue for subsequent calls, so
+        every scenario's rows reach the learner.  ``fanin_min_ready``
+        additionally lets the collect return before slow fleets
+        contribute (their rows zero-masked this round)."""
         while True:
+            if self._pending_group_batches:
+                batch, seg_reward, fleets = \
+                    self._pending_group_batches.pop(0)
+                self._last_update_fleets = fleets
+                return self._fanin.to_device(batch), seg_reward
             if deadline is not None and time.perf_counter() >= deadline:
                 return None
             self._maybe_restart_fleets()
@@ -687,23 +836,42 @@ class ActorLearner:
                     time.monotonic() + deadline - time.perf_counter()
                 )
             segs = self._fanin.collect(
-                self._fleet_alive, self._stop, deadline=mono_deadline
+                self._fleet_alive, self._stop, deadline=mono_deadline,
+                min_ready=self.fanin_min_ready,
             )
             if deadline is not None and time.perf_counter() >= deadline:
                 self._fanin.recycle_segments(segs)
                 return None
             if segs:
-                reward_sum = sum(
-                    float(s.data["rewards"].sum()) for s in segs.values()
-                )
-                reward_n = sum(
-                    s.data["rewards"].size for s in segs.values()
-                )
-                batch = self._fanin.assemble(segs, stop_event=self._stop)
-                if batch is None:
-                    return None
-                dev = self._fanin.to_device(batch)
-                return dev, reward_sum / max(reward_n, 1)
+                if self.curriculum is not None \
+                        and self.randomizer is not None:
+                    # per-scenario return evidence for the curriculum
+                    for f, s in segs.items():
+                        self.curriculum.observe_return(
+                            self.randomizer.scenario_of(f),
+                            float(s.data["rewards"].mean()),
+                        )
+                rewards = {
+                    f: (float(s.data["rewards"].sum()),
+                        s.data["rewards"].size)
+                    for f, s in segs.items()
+                }
+                queued = []
+                for gid, group in self._fanin.split_groups(segs):
+                    batch = self._fanin.assemble(
+                        group, stop_event=self._stop, _group=gid,
+                    )
+                    if batch is None:
+                        for b, _, _ in queued:
+                            b.recycle()
+                        return None
+                    rsum = sum(rewards[f][0] for f in group)
+                    rn = sum(rewards[f][1] for f in group)
+                    queued.append(
+                        (batch, rsum / max(rn, 1), tuple(group))
+                    )
+                self._pending_group_batches.extend(queued)
+                continue
             if all(not self._fleet_alive(f)
                    for f in range(len(self.pools))):
                 errs = [e for e in self._actor_errors if e is not None]
@@ -747,6 +915,24 @@ class ActorLearner:
         self._fleet_restarts = [0] * len(self.pools)
         self._fleet_restart_allowed = [0.0] * len(self.pools)
         self._fleet_restart_steps = [0] * len(self.pools)
+        self._scenario_steps_by_fleet = [
+            dict() for _ in range(len(self.pools))
+        ]
+        self._updates_by_scenario = {}
+        for b, _, _ in self._pending_group_batches:
+            b.recycle()  # a previous run's stale-policy leftovers
+        self._pending_group_batches = []
+        self._last_update_fleets = ()
+        if self.randomizer is not None and self.curriculum is not None \
+                and not any(
+                    s is not None for s in self.randomizer.assignments
+                ):
+            # bootstrap: never-assigned fleets get the curriculum's
+            # starting mix before the first rollout, so scenario labels
+            # exist from the first transition
+            self.randomizer.apply_assignment(
+                self.curriculum.assign(len(self.pools))
+            )
         try:
             while True:
                 self._q.get_nowait()
@@ -810,6 +996,8 @@ class ActorLearner:
                 self._publish_params()
                 losses.append(float(loss))
                 seg_rewards.append(seg_reward)
+                self._note_update_scenarios()
+                self._tick_curriculum()
                 if self.replay is not None and self.replay_ratio > 0:
                     self._drain_replay_ratio(replay_losses)
         finally:
@@ -842,4 +1030,17 @@ class ActorLearner:
             stats["replay_updates"] = len(replay_losses)
             stats["replay_losses"] = replay_losses
             stats["replay"] = self.replay.stats()
+        if self.randomizer is not None or self.curriculum is not None:
+            live = self.stats()
+            stats["env_steps_by_scenario"] = \
+                live.get("env_steps_by_scenario", {})
+            stats["updates_by_scenario"] = \
+                live.get("updates_by_scenario", {})
+            if "scenario_mix" in live:
+                stats["scenario_mix"] = live["scenario_mix"]
+            if "scenario_assignments" in live:
+                stats["scenario_assignments"] = \
+                    live["scenario_assignments"]
+            if self.curriculum is not None:
+                stats["curriculum"] = self.curriculum.stats()
         return stats

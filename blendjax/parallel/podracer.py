@@ -159,6 +159,13 @@ class SegmentFanIn:
             ArenaPool(pool_size=queue_size + 2)
             for _ in range(self.num_fleets)
         ]
+        # per-scenario arenas (docs/scenarios.md): heterogeneous fleets
+        # may carry per-scenario obs shapes, and one shared arena would
+        # thrash reallocation flip-flopping between them.  Shape
+        # signatures are interned; group 0 (the first signature seen —
+        # the only one in a homogeneous run) keeps the unsuffixed
+        # buffer names, so the homogeneous path is bit-identical.
+        self._shape_groups = {}
 
     # -- actor side ----------------------------------------------------------
 
@@ -194,7 +201,8 @@ class SegmentFanIn:
 
     # -- learner side --------------------------------------------------------
 
-    def collect(self, alive_fn, stop_event, deadline=None, poll=0.2):
+    def collect(self, alive_fn, stop_event, deadline=None, poll=0.2,
+                min_ready=None):
         """One segment per live fleet: ``{fleet_id: ArenaBatch}``.
 
         A fleet with ``alive_fn(f)`` False AND an empty queue contributes
@@ -203,13 +211,27 @@ class SegmentFanIn:
         unbounded stall is every fleet dying, which the caller detects.
         Returns the partial dict immediately when ``stop_event`` sets or
         ``deadline`` (``time.monotonic`` seconds) passes — the caller
-        must :meth:`recycle_segments` anything it does not assemble."""
+        must :meth:`recycle_segments` anything it does not assemble.
+
+        ``min_ready`` (docs/scenarios.md, heterogeneous fleets): return
+        as soon as at least that many live fleets have contributed —
+        the fan-in analog of ``step_wait(min_ready=k)``.  A rich/slow
+        scenario's fleet then rides into whichever update its segment
+        lands in (its rows zero-masked meanwhile) instead of stalling
+        every update to its frame rate.  None keeps the all-live
+        barrier (the homogeneous default, bit-identical behavior)."""
         out = {}
         pending = set(range(self.num_fleets))
         while pending:
             if stop_event.is_set():
                 break
             if deadline is not None and time.monotonic() >= deadline:
+                break
+            if min_ready is not None and len(out) >= min(
+                min_ready,
+                max(1, sum(1 for f in range(self.num_fleets)
+                           if alive_fn(f))),
+            ):
                 break
             progressed = False
             for f in sorted(pending):
@@ -242,7 +264,55 @@ class SegmentFanIn:
         for s in segs.values():
             s.recycle()
 
-    def assemble(self, segs, stop_event=None, timeout=30.0):
+    @staticmethod
+    def _shape_sig(seg):
+        """Per-segment schema signature: key -> (sample shape, dtype)
+        over the segment keys.  Segments sharing a signature assemble
+        into one global batch; differing ones (heterogeneous scenario
+        resolutions/obs dims) get their own group."""
+        return tuple(
+            (key, seg.data[key].shape[1:], str(seg.data[key].dtype))
+            for key in SEGMENT_KEYS
+        )
+
+    def split_groups(self, segs):
+        """Partition per-fleet segments by shape signature, interned
+        first-seen-first: ``[(group_index, {fid: seg}), ...]`` in group
+        order.  One group (every homogeneous run) is the common case."""
+        groups = {}
+        for f in sorted(segs):
+            sig = self._shape_sig(segs[f])
+            gid = self._shape_groups.setdefault(
+                sig, len(self._shape_groups)
+            )
+            groups.setdefault(gid, {})[f] = segs[f]
+        return sorted(groups.items())
+
+    def assemble_groups(self, segs, stop_event=None, timeout=30.0):
+        """:meth:`assemble` tolerant of per-scenario obs shapes: the
+        segments are partitioned by shape signature and each group is
+        assembled into its OWN full-width global batch (the other
+        groups' fleet rows zero-masked), so a mixed-resolution fleet
+        set never forces one global shape — the learner runs one
+        masked update per group instead of crashing on a ragged
+        stack.  Returns a list of ``ArenaBatch`` (singleton — and
+        bit-identical to :meth:`assemble` — whenever shapes agree)."""
+        out = []
+        try:
+            for gid, group in self.split_groups(segs):
+                out.append(self.assemble(
+                    group, stop_event=stop_event, timeout=timeout,
+                    _group=gid,
+                ))
+                if out[-1] is None:
+                    out.pop()
+        except BaseException:
+            for b in out:
+                b.recycle()
+            raise
+        return out
+
+    def assemble(self, segs, stop_event=None, timeout=30.0, _group=0):
         """Scatter per-fleet segments into one env-major global batch.
 
         Returns an :class:`ArenaBatch` whose data is ``{obs, actions,
@@ -250,7 +320,8 @@ class SegmentFanIn:
         absent fleets and divisibility padding zero-filled and carried at
         ``mask`` 0.  Fleet arenas recycle as soon as their rows are
         copied; the global arena recycles after the device transfer
-        (:meth:`to_device`)."""
+        (:meth:`to_device`).  Segments must share one shape signature —
+        route mixed-scenario sets through :meth:`assemble_groups`."""
         if not segs:
             raise ValueError("assemble needs at least one fleet segment")
         arena = self.arena_pool.acquire(timeout=timeout, stop_event=stop_event)
@@ -265,14 +336,21 @@ class SegmentFanIn:
             )
         first = next(iter(segs.values())).data
         t_len = first["rewards"].shape[0]
+        # group > 0 buffers get their own arena paths so heterogeneous
+        # shape groups never thrash each other's preallocations (group
+        # 0 keeps the plain names: homogeneous runs are untouched)
+        suffix = "" if _group == 0 else f"@g{_group}"
         data = {}
         for key in SEGMENT_KEYS:
             tail = first[key].shape[2:]
             buf = arena.get_buffer(
-                key, (self.n_padded, t_len) + tail, first[key].dtype
+                key + suffix, (self.n_padded, t_len) + tail,
+                first[key].dtype
             )
             data[key] = buf
-        mask = arena.get_buffer("mask", (self.n_padded,), np.float32)
+        mask = arena.get_buffer(
+            "mask" + suffix, (self.n_padded,), np.float32
+        )
         mask[:] = 0.0
         for f, seg in segs.items():
             o, n = int(self.offsets[f]), self.fleet_sizes[f]
@@ -327,22 +405,40 @@ class FleetSet:
 
     Use as a context manager; pass ``fleet_set.pools`` (or the set
     itself) to :class:`~blendjax.models.actor_learner.ActorLearner`.
+
+    Scenario plane (docs/scenarios.md): ``ctrl=True`` allocates a
+    second named socket (``CTRL``) per instance — the duplex control
+    endpoints, exposed per fleet on :attr:`ctrl_addresses` in exactly
+    the shape :class:`~blendjax.scenario.DomainRandomizer` takes — and
+    ``fleet_env_kwargs`` (one dict per fleet, layered over the shared
+    ``**env_kwargs``) launches HETEROGENEOUS fleets: per-scenario
+    physics rates, resolutions or scene params from the first frame
+    (e.g. ``fleet_env_kwargs=[spec.env_kwargs() for spec in ...]``).
     """
 
     def __init__(self, scene, script, num_fleets, envs_per_fleet, *,
                  background=True, start_port=21000, port_stride=100,
                  timeoutms=None, fault_policy=None, supervise=True,
-                 interval=0.5, restart=True, **env_kwargs):
+                 interval=0.5, restart=True, ctrl=False,
+                 fleet_env_kwargs=None, **env_kwargs):
         if num_fleets < 1 or envs_per_fleet < 1:
             raise ValueError("num_fleets and envs_per_fleet must be >= 1")
-        if envs_per_fleet * 2 > port_stride:
-            # each instance binds one GYM port (launchers may probe past
-            # collisions, hence the 2x margin): a fleet spilling into the
-            # next fleet's range would crosstalk with no useful error
+        sockets_per_env = 2 if ctrl else 1
+        if envs_per_fleet * 2 * sockets_per_env > port_stride:
+            # each instance binds one port per named socket (launchers
+            # may probe past collisions, hence the 2x margin): a fleet
+            # spilling into the next fleet's range would crosstalk with
+            # no useful error
             raise ValueError(
                 f"envs_per_fleet={envs_per_fleet} does not fit in "
                 f"port_stride={port_stride}; raise port_stride to at "
-                "least 2x the fleet size"
+                f"least {2 * sockets_per_env}x the fleet size"
+            )
+        if fleet_env_kwargs is not None \
+                and len(fleet_env_kwargs) != num_fleets:
+            raise ValueError(
+                f"fleet_env_kwargs names {len(fleet_env_kwargs)} fleets, "
+                f"num_fleets={num_fleets}"
             )
         self.num_fleets = num_fleets
         self.envs_per_fleet = envs_per_fleet
@@ -351,11 +447,16 @@ class FleetSet:
             start_port=start_port, port_stride=port_stride,
             timeoutms=timeoutms, fault_policy=fault_policy,
             supervise=supervise, interval=interval, restart=restart,
+            ctrl=bool(ctrl), fleet_env_kwargs=fleet_env_kwargs,
             env_kwargs=env_kwargs,
         )
         self.launchers = []
         self.pools = []
         self.supervisors = []
+        #: per-fleet CTRL endpoint lists (``ctrl=True`` only) — the
+        #: scenario plane's control addresses, in the shape
+        #: :class:`~blendjax.scenario.DomainRandomizer` takes
+        self.ctrl_addresses = []
         self._stack = []
 
     def __enter__(self):
@@ -367,23 +468,34 @@ class FleetSet:
         from blendjax.utils.timing import EventCounters
 
         cfg = self._cfg
+        sockets = ["GYM"] + (["CTRL"] if cfg["ctrl"] else [])
         try:
             for f in range(self.num_fleets):
+                # per-fleet overrides layered over the shared kwargs:
+                # heterogeneous fleets (per-scenario physics rates /
+                # scene params, docs/scenarios.md) differ only here
+                fkw = dict(cfg["env_kwargs"])
+                if cfg["fleet_env_kwargs"] is not None:
+                    fkw.update(cfg["fleet_env_kwargs"][f] or {})
                 bl = BlenderLauncher(
                     scene=cfg["scene"],
                     script=cfg["script"],
                     num_instances=self.envs_per_fleet,
-                    named_sockets=["GYM"],
+                    named_sockets=sockets,
                     start_port=cfg["start_port"] + f * cfg["port_stride"],
                     background=cfg["background"],
                     instance_args=[
-                        list(kwargs_to_cli(cfg["env_kwargs"]))
+                        list(kwargs_to_cli(fkw))
                         for _ in range(self.envs_per_fleet)
                     ],
                 )
                 bl.__enter__()
                 self._stack.append(bl)
                 self.launchers.append(bl)
+                if cfg["ctrl"]:
+                    self.ctrl_addresses.append(
+                        list(bl.launch_info.addresses["CTRL"])
+                    )
             for f, bl in enumerate(self.launchers):
                 counters = EventCounters()
                 pool = EnvPool(

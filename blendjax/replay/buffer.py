@@ -53,6 +53,17 @@ from blendjax.utils.timing import StageTimer, fleet_counters
 #: appends — the flag travels inside the recorded message).
 HEALTHY_KEY = "healthy"
 
+#: Transition key reserved for the scenario id (docs/scenarios.md):
+#: same in-band pattern as :data:`HEALTHY_KEY` — consumed into a
+#: per-slot stamp at append time, never stored as a column, and it
+#: travels inside recorded ``.btr`` messages so a prefilled buffer's
+#: stamps (and stored bytes) are bit-identical to direct appends.
+#: Stamps feed per-scenario strata (:meth:`ReplayBuffer.scenario_stats`
+#: and the ``scenario_mix=`` draw shaping) and never touch the RNG or
+#: the sum tree on their own, so a stamped-but-unmixed buffer draws the
+#: exact scenario-less stream.
+SCENARIO_KEY = "scenario"
+
 
 def load_client_state(buf, arrays, meta):
     """Apply checkpointed sampling state (eligibility masks, generations,
@@ -65,6 +76,14 @@ def load_client_state(buf, arrays, meta):
     if "gen" in arrays:
         buf._gen = np.array(arrays["gen"], np.int64)
         buf._drawn_gen = np.array(arrays["drawn_gen"], np.int64)
+    if "scenario" in arrays:
+        # scenario stamps + the id<->name interning table (older
+        # checkpoints carry neither: every slot restores unlabelled)
+        buf._scenario = np.array(arrays["scenario"], np.int32)
+        buf._scenario_names = list(meta.get("scenario_names", []))
+        buf._scenario_ids = {
+            n: i for i, n in enumerate(buf._scenario_names)
+        }
     if buf.tree is not None:
         buf.tree.rebuild(arrays["tree_leaves"])
     buf._head = int(meta["head"])
@@ -144,6 +163,14 @@ class ReplayBuffer:
         # to the evicted transition, not the new occupant)
         self._gen = np.zeros(self.capacity, np.int64)
         self._drawn_gen = np.full(self.capacity, -1, np.int64)
+        # per-slot scenario stamp (-1 = unlabelled) + the string<->int
+        # interning table; stamps are pure bookkeeping — they never
+        # touch the RNG or the tree, so the draw stream of a stamped
+        # buffer is bit-identical to an unstamped one unless a
+        # NON-uniform ``scenario_mix`` explicitly shapes a draw
+        self._scenario = np.full(self.capacity, -1, np.int32)
+        self._scenario_names = []
+        self._scenario_ids = {}
         self._head = 0
         self._size = 0
         self._num_valid = 0
@@ -188,7 +215,19 @@ class ReplayBuffer:
         tree space: ``(|p| + eps)^alpha``."""
         return float(abs(priority) + self.eps) ** self.alpha
 
-    def append(self, transition, *, healthy=True, priority=None):
+    def _scenario_id_locked(self, scenario):
+        """Intern a scenario name (caller holds the lock); -1 for None."""
+        if scenario is None:
+            return -1
+        sid = self._scenario_ids.get(scenario)
+        if sid is None:
+            sid = len(self._scenario_names)
+            self._scenario_names.append(str(scenario))
+            self._scenario_ids[str(scenario)] = sid
+        return sid
+
+    def append(self, transition, *, healthy=True, priority=None,
+               scenario=None):
         """Append one transition dict (O(1), no allocation after the
         first row fixes the schema).  Returns the ring slot written.
 
@@ -196,15 +235,25 @@ class ReplayBuffer:
         :func:`~blendjax.replay.prefill.transition_to_message`) is
         consumed into the flag rather than stored; the ``healthy``
         kwarg ANDs with it.  Unhealthy rows are stored (inspectable via
-        :meth:`get`) but never sampled.
+        :meth:`get`) but never sampled.  A ``transition[SCENARIO_KEY]``
+        string (or the ``scenario`` kwarg; the in-band value wins) is
+        consumed into the slot's scenario stamp the same way —
+        docs/scenarios.md — feeding the per-scenario strata without
+        becoming a stored column.
 
         ``priority``: caller-space magnitude for prioritized mode; new
         rows default to the running max so they are sampled at least
         once before their first priority update.
         """
-        if HEALTHY_KEY in transition:
+        if HEALTHY_KEY in transition or SCENARIO_KEY in transition:
             transition = dict(transition)
-            healthy = bool(transition.pop(HEALTHY_KEY)) and bool(healthy)
+            if HEALTHY_KEY in transition:
+                healthy = bool(transition.pop(HEALTHY_KEY)) \
+                    and bool(healthy)
+            if SCENARIO_KEY in transition:
+                inband = transition.pop(SCENARIO_KEY)
+                if inband is not None:
+                    scenario = inband
         t0 = time.perf_counter()
         with self._cond:
             slot = self._head
@@ -221,6 +270,10 @@ class ReplayBuffer:
                 self._excluded -= 1  # evicted an excluded row
             self._healthy[slot] = healthy
             self._valid[slot] = healthy
+            sid = self._scenario_id_locked(scenario)
+            self._scenario[slot] = sid
+            if sid >= 0:
+                self.counters.incr("scenario_rows_stamped")
             self._gen[slot] += 1
             if healthy:
                 self._num_valid += 1
@@ -244,12 +297,18 @@ class ReplayBuffer:
         self.timer.add("replay_append", time.perf_counter() - t0, _t0=t0)
         return slot
 
-    def extend(self, transitions, *, healthy=None):
+    def extend(self, transitions, *, healthy=None, scenarios=None):
         """Append a sequence of transition dicts; ``healthy`` is an
         optional parallel bool sequence (e.g. the pool's per-env health
-        mask for one step)."""
+        mask for one step) and ``scenarios`` an optional parallel
+        scenario-name sequence (e.g. the per-env stamps one fleet step
+        produced)."""
         for i, tr in enumerate(transitions):
-            self.append(tr, healthy=True if healthy is None else bool(healthy[i]))
+            self.append(
+                tr,
+                healthy=True if healthy is None else bool(healthy[i]),
+                scenario=None if scenarios is None else scenarios[i],
+            )
 
     def get(self, index):
         """One stored transition (values copied out), including excluded
@@ -293,8 +352,99 @@ class ReplayBuffer:
             weights = np.ones(batch_size, np.float32)
         return idx, weights
 
+    def _drawable_mask_locked(self):
+        """Rows drawable RIGHT NOW (caller holds the lock).  The base
+        buffer draws from every eligible row; :class:`ShardedReplay`
+        overrides this to exclude quarantined-shard and journaled rows,
+        so the scenario-strata draw honors the same degraded-mode
+        eligibility its base draw does."""
+        return self._valid
+
+    def _effective_mix_locked(self, scenario_mix):
+        """Resolve a requested scenario mix to the strata the draw can
+        actually honor (caller holds the lock), or None for the base
+        draw path.
+
+        None and UNIFORM mixes resolve to None — the scenario-less
+        identity, byte-identical on the draw stream by construction
+        (the regression-locked contract: scenario plane off, or on at
+        uniform, changes nothing).  Scenarios with no eligible rows are
+        dropped and the rest renormalized (degraded strata, the same
+        spirit as shard-outage renormalization); a mix with NO
+        satisfiable stratum also falls back to the base path rather
+        than starving the learner."""
+        if not scenario_mix:
+            return None
+        drawable = self._drawable_mask_locked()
+        vals = [float(v) for v in scenario_mix.values()]
+        if max(vals) - min(vals) < 1e-12:
+            # uniform — the identity, but ONLY when it spans every
+            # drawable row (the curriculum's uniform mix always names
+            # the whole catalog).  An equal-weight PARTIAL mix (e.g.
+            # one scenario pinned alone) genuinely restricts the draw
+            # and must take the strata path.
+            ids = [self._scenario_ids[n] for n in scenario_mix
+                   if n in self._scenario_ids]
+            if not drawable.any() or np.isin(
+                self._scenario[drawable], ids
+            ).all():
+                return None
+        live = {}
+        for name, w in scenario_mix.items():
+            if w <= 0:
+                continue
+            sid = self._scenario_ids.get(name)
+            if sid is None:
+                continue
+            if bool((drawable
+                     & (self._scenario == sid)).any()):
+                live[name] = float(w)
+        if not live:
+            return None
+        total = sum(live.values())
+        return {n: w / total for n, w in live.items()}
+
+    def _draw_strata_locked(self, batch_size, beta, mix):
+        """Scenario-stratified draw (non-uniform mix only): batch rows
+        apportioned per stratum (largest remainder, mix order), drawn
+        within each stratum by the stratum's own tree-priority mass
+        (uniform inside a stratum when unprioritized).  IS weights use
+        the true under-mix sampling probability
+        ``P(i) = mix[s] * p_i / mass_s``, so the PER bias correction
+        stays exact under the reweighted draw."""
+        from blendjax.scenario.curriculum import apportion
+
+        drawable = self._drawable_mask_locked()
+        counts = {}
+        for name in apportion(mix, batch_size):
+            counts[name] = counts.get(name, 0) + 1
+        idx_parts, prob_parts = [], []
+        for name in mix:
+            k = counts.get(name, 0)
+            if k == 0:
+                continue
+            sid = self._scenario_ids[name]
+            slots = np.flatnonzero(drawable & (self._scenario == sid))
+            if self.tree is not None:
+                p = self.tree.get_many(slots.astype(np.int64))
+                mass = float(p.sum())
+                probs = (p / mass) if mass > 0 else np.full(
+                    slots.size, 1.0 / slots.size
+                )
+            else:
+                probs = np.full(slots.size, 1.0 / slots.size)
+            pick = self._rng.choice(slots.size, size=k, p=probs)
+            idx_parts.append(slots[pick].astype(np.int64))
+            prob_parts.append(mix[name] * probs[pick])
+        idx = np.concatenate(idx_parts)
+        probs = np.concatenate(prob_parts)
+        weights = (self._num_valid * np.maximum(probs, 1e-12)) ** -beta
+        weights = (weights / weights.max()).astype(np.float32)
+        self.counters.incr("scenario_strata_draws")
+        return idx, weights
+
     def sample(self, batch_size, *, beta=None, min_size=None, timeout=30.0,
-               out=None, stop_event=None, keys=None):
+               out=None, stop_event=None, keys=None, scenario_mix=None):
         """Draw one prioritized (or uniform) batch.
 
         Returns ``(data, indices, weights)``: ``data`` is a dict of
@@ -304,6 +454,15 @@ class ReplayBuffer:
         ``weights`` the normalized IS weights (all ones when uniform).
         ``keys`` restricts the gather (and any device transfer behind
         it) to the columns the consumer actually reads.
+
+        ``scenario_mix`` (docs/scenarios.md): a name->weight dict
+        shapes the draw over per-scenario strata — rows apportioned
+        per stratum, drawn within each by its own priority mass, IS
+        weights corrected for the reweighting.  ``None`` and UNIFORM
+        mixes take the exact scenario-less draw path (byte-identical
+        stream — the scenario plane's no-op contract, regression
+        locked); strata with no eligible rows are dropped and the rest
+        renormalized.
 
         Blocks while fewer than ``min_size`` (default ``batch_size``)
         eligible rows exist — the learner outpacing the actor — timed
@@ -341,9 +500,15 @@ class ReplayBuffer:
                     self._cond.wait(min(0.1, remaining))
                 self.timer.add("sample_wait", time.perf_counter() - t0, _t0=t0)
             t0 = time.perf_counter()
-            idx, weights = self._draw_locked(
-                batch_size, self.beta if beta is None else beta
-            )
+            mix = self._effective_mix_locked(scenario_mix)
+            if mix is None:
+                idx, weights = self._draw_locked(
+                    batch_size, self.beta if beta is None else beta
+                )
+            else:
+                idx, weights = self._draw_strata_locked(
+                    batch_size, self.beta if beta is None else beta, mix
+                )
             self._drawn_gen[idx] = self._gen[idx]
             data = self.store.gather(idx, out=out, keys=keys)
             self._samples += 1
@@ -388,7 +553,8 @@ class ReplayBuffer:
         self.timer.add("priority_update", time.perf_counter() - t0, _t0=t0)
 
     def sample_batches(self, batch_size, *, arena_pool=None, beta=None,
-                       stop_event=None, timeout=30.0, keys=None):
+                       stop_event=None, timeout=30.0, keys=None,
+                       scenario_mix=None):
         """Generator of sampled batches for the device feed: each batch
         is gathered straight into a recycled
         :class:`~blendjax.btt.arena.Arena` when ``arena_pool`` is given
@@ -432,6 +598,7 @@ class ReplayBuffer:
                 res = self.sample(
                     batch_size, beta=beta, out=out,
                     stop_event=stop_event, timeout=timeout, keys=keys,
+                    scenario_mix=scenario_mix,
                 )
             except BaseException:
                 if arena is not None:
@@ -461,9 +628,11 @@ class ReplayBuffer:
         arrays["healthy"] = self._healthy
         arrays["gen"] = self._gen
         arrays["drawn_gen"] = self._drawn_gen
+        arrays["scenario"] = self._scenario
         if self.tree is not None:
             arrays["tree_leaves"] = self.tree.leaves()
         meta = {
+            "scenario_names": list(self._scenario_names),
             "format": "blendjax.replay/1",
             "capacity": self.capacity,
             "head": self._head,
@@ -516,11 +685,50 @@ class ReplayBuffer:
 
     # -- observability -------------------------------------------------------
 
+    def scenario_stats(self):
+        """Per-scenario strata snapshot (docs/scenarios.md): for every
+        interned scenario, its live ``rows``, sampling-``eligible``
+        rows, and ``priority_mass`` (sum of its eligible rows' tree
+        priorities — the TD-error evidence the
+        :class:`~blendjax.scenario.CurriculumScheduler` reweights on;
+        the eligible count itself when unprioritized).  ``_unlabelled``
+        rows ride under that key so the strata always account for every
+        occupied slot.  Computed on demand — stamps cost nothing on the
+        append/draw hot paths, and a buffer with NO stamps at all
+        returns ``{}`` without touching the arrays (a scenario-less
+        deployment's periodic health scrape stays O(1) here)."""
+        with self._cond:
+            if not self._scenario_names:
+                return {}
+            occupied = np.zeros(self.capacity, bool)
+            occupied[:self._size] = True
+            leaves = self.tree.leaves() if self.tree is not None else None
+            out = {}
+            for sid in range(-1, len(self._scenario_names)):
+                mask = occupied & (self._scenario == sid)
+                rows = int(mask.sum())
+                if sid < 0 and rows == 0:
+                    continue  # fully-labelled buffer: no _unlabelled row
+                eligible = mask & self._valid
+                name = ("_unlabelled" if sid < 0
+                        else self._scenario_names[sid])
+                out[name] = {
+                    "rows": rows,
+                    "eligible": int(eligible.sum()),
+                    "priority_mass": float(
+                        leaves[eligible].sum() if leaves is not None
+                        else eligible.sum()
+                    ),
+                }
+            return out
+
     def stats(self):
         """One snapshot for ``FleetSupervisor.health()``: fill state,
         exclusion accounting, and the replay stage timings."""
+        scenarios = self.scenario_stats()
         with self._cond:
             return {
+                "scenarios": scenarios,
                 "name": self.name,
                 "size": self._size,
                 "capacity": self.capacity,

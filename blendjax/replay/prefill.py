@@ -36,22 +36,30 @@ from glob import glob
 from pathlib import Path
 
 from blendjax.btt.file import FileReader
-from blendjax.replay.buffer import HEALTHY_KEY
+from blendjax.replay.buffer import HEALTHY_KEY, SCENARIO_KEY
 
 
-def transition_to_message(transition, *, healthy=True):
+def transition_to_message(transition, *, healthy=True, scenario=None):
     """Transition dict -> recordable message: the dict itself with the
-    quarantine flag in-band under :data:`HEALTHY_KEY`."""
+    quarantine flag in-band under :data:`HEALTHY_KEY` and (when known)
+    the scenario id under :data:`SCENARIO_KEY` — both consumed back
+    into per-slot bookkeeping by :meth:`ReplayBuffer.append`, so a
+    ``.btr``-prefilled buffer is bit-identical (stored bytes AND
+    stamps) to one fed the same transitions directly."""
     msg = dict(transition)
     msg[HEALTHY_KEY] = bool(
         msg.get(HEALTHY_KEY, True)
     ) and bool(healthy)
+    if scenario is not None and SCENARIO_KEY not in msg:
+        msg[SCENARIO_KEY] = str(scenario)
     return msg
 
 
 def message_to_transition(message):
     """Recorded message -> ``(transition, healthy)``; the inverse of
-    :func:`transition_to_message` (flag stripped from the dict)."""
+    :func:`transition_to_message` (the health flag stripped from the
+    dict; a :data:`SCENARIO_KEY` stamp stays IN-BAND — ``append``
+    consumes it, keeping prefilled stamps identical to live ones)."""
     transition = dict(message)
     healthy = bool(transition.pop(HEALTHY_KEY, True))
     return transition, healthy

@@ -666,6 +666,15 @@ class ShardedReplay(ReplayBuffer):
             return super()._draw_locked(batch_size, beta)
         return self._draw_degraded_locked(batch_size, beta)
 
+    def _drawable_mask_locked(self):
+        """Scenario-strata draws (docs/scenarios.md) honor the same
+        degraded-mode eligibility as the base draw: rows on
+        quarantined shards or waiting in the journal cannot be
+        gathered, so they must not be selected by a stratum either."""
+        if not self._dead.any() and not self._pending.any():
+            return self._valid
+        return self._eligible_live_locked()
+
     def _draw_degraded_locked(self, batch_size, beta):
         """The degraded draw: strata renormalized over the LIVE,
         drawable priority mass.  The master tree is never mutated by

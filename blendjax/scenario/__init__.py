@@ -1,0 +1,41 @@
+"""Scenario plane: catalog, live domain randomization, curriculum.
+
+The workload-diversity axis (ROADMAP #5): named
+:class:`ScenarioSpec`/:class:`ScenarioCatalog` scene configs with
+seeded sampling and JSON round-trip, a :class:`DomainRandomizer` that
+pushes sampled params into RUNNING producers over the duplex control
+plane (the densityopt pattern), and a :class:`CurriculumScheduler`
+that reweights the fleet's scenario mix from per-scenario replay
+strata.  Scenario ids ride in-band on transitions (the ``healthy``-key
+pattern), so replay rows, telemetry and serve traffic all attribute to
+scenarios.  See docs/scenarios.md.
+
+Import-light on purpose (numpy + zmq lazily via the duplex channel):
+usable from producer-side scripts and jax-free processes alike.
+"""
+
+from blendjax.scenario.catalog import (  # noqa: F401
+    CATALOG_FORMAT,
+    ScenarioCatalog,
+    ScenarioSpec,
+)
+from blendjax.scenario.curriculum import (  # noqa: F401
+    POLICIES,
+    CurriculumScheduler,
+    apportion,
+)
+from blendjax.scenario.randomize import (  # noqa: F401
+    PUSH_CMD,
+    DomainRandomizer,
+)
+
+__all__ = [
+    "CATALOG_FORMAT",
+    "POLICIES",
+    "PUSH_CMD",
+    "CurriculumScheduler",
+    "DomainRandomizer",
+    "ScenarioCatalog",
+    "ScenarioSpec",
+    "apportion",
+]

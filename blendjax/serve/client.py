@@ -161,7 +161,7 @@ class ServeClient:
             payload["model"] = self.model
         return payload
 
-    def reset(self, prefix=None, timeout_ms=None):
+    def reset(self, prefix=None, timeout_ms=None, scenario=None):
         """Admit an episode: returns (and remembers) its slot id.  The
         reply's episode *lease* id rides every later step/close, so a
         slot the server evicted and reassigned refuses this client's
@@ -172,10 +172,18 @@ class ServeClient:
         teacher-forced batched pass (not T serial decodes) and the full
         reply dict is returned instead of the slot, with ``pred`` (the
         prediction for position T) and ``pos`` (the position the next
-        ``step`` consumes)."""
+        ``step`` consumes).
+
+        ``scenario`` — an optional traffic label (docs/scenarios.md):
+        rides the admission request, and a fronting
+        :class:`~blendjax.serve.gateway.ServeGateway` attributes the
+        whole episode's requests/latencies to it in its per-scenario
+        records (bare servers ignore it)."""
         payload = self._model_payload({})
         if prefix is not None:
             payload["prefix"] = np.asarray(prefix, np.float32)
+        if scenario is not None:
+            payload["scenario"] = str(scenario)
         reply = self.rpc("reset", payload, timeout_ms=timeout_ms,
                          raw_buffers=prefix is not None)
         self.slot = int(reply["slot"])

@@ -107,6 +107,12 @@ GATEWAY_CMDS = ("hello", "stats", "telemetry", "drain", "undrain",
 #: publish rate.
 VERSION_STATS_DEPTH = 8
 
+#: Per-scenario reply metrics kept (oldest label evicted first):
+#: bounded regardless of how many scenario labels clients invent —
+#: a catalog is typically a handful, this is headroom
+#: (docs/scenarios.md).
+SCENARIO_STATS_DEPTH = 32
+
 
 class _Replica:
     """One backend replica: its DEALER channel plus the cached scrape
@@ -176,9 +182,10 @@ class _Replica:
 
 class _Lease:
     __slots__ = ("rid", "slot", "episode", "model", "incarnation",
-                 "dead", "t_use")
+                 "dead", "t_use", "scenario")
 
-    def __init__(self, rid, slot, episode, model, incarnation):
+    def __init__(self, rid, slot, episode, model, incarnation,
+                 scenario=None):
         self.rid = rid
         self.slot = slot
         self.episode = episode  # the replica's REAL lease id
@@ -186,14 +193,18 @@ class _Lease:
         self.incarnation = incarnation
         self.dead = False
         self.t_use = time.monotonic()
+        #: scenario label the episode was admitted under (None =
+        #: unlabelled traffic) — every step/close inherits it for the
+        #: per-scenario reply records (docs/scenarios.md)
+        self.scenario = scenario
 
 
 class _Route:
     __slots__ = ("ident", "rid", "inc", "cmd", "model", "gw_ep", "t0",
-                 "span_trace", "t0_us")
+                 "span_trace", "t0_us", "scenario")
 
     def __init__(self, ident, rid, inc, cmd, model, gw_ep, span_trace,
-                 t0_us):
+                 t0_us, scenario=None):
         self.ident = ident
         self.rid = rid
         self.inc = inc  # replica incarnation at forward time
@@ -203,6 +214,7 @@ class _Route:
         self.t0 = time.perf_counter()
         self.span_trace = span_trace
         self.t0_us = t0_us
+        self.scenario = scenario
 
 
 class ServeGateway:
@@ -310,6 +322,12 @@ class ServeGateway:
         #: controller thread iterates via version_stats()
         self._version_stats = OrderedDict()
         self._version_stats_lock = threading.Lock()
+        #: per-scenario reply metrics (docs/scenarios.md): requests /
+        #: errors / client round-trip histogram per scenario LABEL,
+        #: next to the per-version records — the serve tier's view of
+        #: a labelled traffic mix.  Same lock discipline as the
+        #: version stats (IO thread writes, scrapers iterate).
+        self._scenario_stats = OrderedDict()
 
     # -- admin (callable from any thread; applied under the GIL) -------------
 
@@ -434,6 +452,42 @@ class ServeGateway:
             if is_error:
                 rec["errors"] += 1
             rec["hist"].add(latency_s)
+
+    def scenario_stats(self):
+        """Per-scenario reply metrics: ``{scenario: {"requests",
+        "errors", "p50_ms", "p99_ms"}}`` — client round-trip through
+        this gateway per traffic label, the serve tier's per-scenario
+        QPS/latency record (docs/scenarios.md)."""
+        with self._version_stats_lock:
+            items = [(s, rec["requests"], rec["errors"],
+                      rec["hist"].copy())
+                     for s, rec in self._scenario_stats.items()]
+        out = {}
+        for s, requests, errors, hist in items:
+            pct = hist.percentiles()
+            out[s] = {
+                "requests": requests,
+                "errors": errors,
+                "p50_ms": pct["p50_ms"],
+                "p99_ms": pct["p99_ms"],
+            }
+        return out
+
+    def _note_scenario_reply(self, scenario, is_error, latency_s):
+        with self._version_stats_lock:
+            rec = self._scenario_stats.get(scenario)
+            if rec is None:
+                rec = self._scenario_stats[scenario] = {
+                    "requests": 0, "errors": 0,
+                    "hist": LatencyHistogram(),
+                }
+                while len(self._scenario_stats) > SCENARIO_STATS_DEPTH:
+                    self._scenario_stats.popitem(last=False)
+            rec["requests"] += 1
+            if is_error:
+                rec["errors"] += 1
+            rec["hist"].add(latency_s)
+        self.counters.incr("scenario_serve_requests")
 
     def notify_replica_death(self, idx_or_rid, exit_code=None):
         """Watchdog ``on_death`` hook: quarantine the replica NOW
@@ -705,6 +759,7 @@ class ServeGateway:
             "routes_inflight": len(self._routes),
             "counters": self.counters.snapshot(),
             "weights": self._weights_snapshot(),
+            "scenarios": self.scenario_stats(),
             "pid": os.getpid(),
         }
 
@@ -734,6 +789,7 @@ class ServeGateway:
             "replicas": {r.id: r.snapshot()
                          for r in self._replicas.values()},
             "weights": self._weights_snapshot(),
+            "scenarios": self.scenario_stats(),
         }
 
     def _cmd_canary(self, msg):
@@ -832,7 +888,8 @@ class ServeGateway:
             self.counters.incr("gateway_rebalances")
         return chosen
 
-    def _forward(self, rep, ident, msg, cmd, model, gw_ep):
+    def _forward(self, rep, ident, msg, cmd, model, gw_ep,
+                 scenario=None):
         """Record the route and relay the request (BTMID verbatim).
         The send is NON-blocking: a replica whose pipe is full (stalled
         process, dead peer past the HWM) must cost its own clients an
@@ -847,7 +904,7 @@ class ServeGateway:
         if mid is not None:
             self._routes[mid] = _Route(ident, rep.id, rep.incarnation,
                                        cmd, model, gw_ep, trace,
-                                       now_us())
+                                       now_us(), scenario)
             while len(self._routes) > ROUTE_CACHE_DEPTH:
                 self._routes.popitem(last=False)
         t0 = time.perf_counter()
@@ -983,7 +1040,7 @@ class ServeGateway:
                     msg["episode"] = lease.episode
                 self.counters.incr("gateway_dup_inflight")
                 self._forward(rep, ident, msg, route.cmd, route.model,
-                              route.gw_ep)
+                              route.gw_ep, scenario=route.scenario)
                 return
             # the replica died holding the request (or the lease did):
             # drop the route and fall through to fresh handling (a
@@ -1028,6 +1085,10 @@ class ServeGateway:
             return
         if cmd == "reset":
             model = msg.get("model")
+            # the traffic label rides the admission request and is
+            # inherited by the episode's lease (docs/scenarios.md);
+            # replicas ignore the extra key
+            scenario = msg.get("scenario")
             rep = self._route_fresh(model)
             self.timer.add("gw_route", time.perf_counter() - t_route)
             if rep is None:
@@ -1038,7 +1099,8 @@ class ServeGateway:
                 )}, span_name="gateway:reset", cache=False)
                 return
             rep.pending_live += 1
-            self._forward(rep, ident, msg, "reset", model, None)
+            self._forward(rep, ident, msg, "reset", model, None,
+                          scenario=scenario)
             return
         if cmd in ("step", "close"):
             gw_ep = msg.get("episode")
@@ -1073,7 +1135,8 @@ class ServeGateway:
             lease.t_use = time.monotonic()
             self.counters.incr("gateway_affinity_hits")
             self.timer.add("gw_route", time.perf_counter() - t_route)
-            self._forward(rep, ident, msg, cmd, lease.model, gw_ep)
+            self._forward(rep, ident, msg, cmd, lease.model, gw_ep,
+                          scenario=lease.scenario)
             return
         self.timer.add("gw_route", time.perf_counter() - t_route)
         self._local_reply(ident, msg, {
@@ -1119,6 +1182,12 @@ class ServeGateway:
             # canary controller's promote/rollback verdicts read
             self._note_version_reply(wv, "error" in reply,
                                      time.perf_counter() - route.t0)
+        if route.scenario is not None:
+            # per-scenario traffic metrics next to the per-version
+            # ones: a labelled mix's QPS/p99 is attributable per
+            # scenario from the gateway alone (docs/scenarios.md)
+            self._note_scenario_reply(route.scenario, "error" in reply,
+                                      time.perf_counter() - route.t0)
         if "error" in reply:
             # name the replica in the traceback the client will raise
             reply["error"] = f"replica {rep.id}: {reply['error']}"
@@ -1152,7 +1221,7 @@ class ServeGateway:
                 gw_ep = self._ep_seq
                 self._leases[gw_ep] = _Lease(
                     rep.id, reply.get("slot"), real_ep, route.model,
-                    rep.incarnation,
+                    rep.incarnation, scenario=route.scenario,
                 )
                 self._lease_rev[key] = gw_ep
             reply["episode"] = gw_ep

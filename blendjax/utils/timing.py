@@ -236,6 +236,57 @@ WEIGHT_EVENTS = (
     "weight_rollback_publishes",
 )
 
+#: Canonical scenario-plane event names (see docs/scenarios.md).  Same
+#: contract as ``FLEET_EVENTS``: any ``EventCounters`` accepts them and
+#: the TelemetryHub zero-fills every name in every scrape.
+#: ``scenario_samples`` — concrete parameter dicts sampled from a
+#: :class:`~blendjax.scenario.ScenarioSpec` (seeded draws over its
+#: randomization ranges);
+#: ``scenario_pushes`` — parameter pushes sent into running producers
+#: over the duplex control plane (the densityopt pattern, live
+#: domain randomization);
+#: ``scenario_push_failures`` — pushes that could not be delivered
+#: (send timeout into a dead/stalled producer; the bounded-timeout
+#: send is what keeps a SIGKILLed producer from wedging the
+#: randomizer — the failed push is counted, never blocked on);
+#: ``scenario_applies`` — pushed scenarios CONFIRMED applied: the
+#: first transition stamped with the newly-pushed scenario id
+#: observed back on the data plane (push is fire-and-forget; this is
+#: the round-trip acknowledgement);
+#: ``scenario_reassignments`` — scenarios re-pushed to a respawned /
+#: re-admitted env over a fresh control channel (a quarantined env's
+#: scenario must survive its producer's death);
+#: ``scenario_curriculum_updates`` — curriculum reweight passes
+#: executed (interval-gated);
+#: ``scenario_mix_changes`` — reweight passes that actually CHANGED
+#: the fleet's scenario mix (what a curriculum-shift test pins);
+#: ``scenario_rows_stamped`` — replay rows appended carrying a
+#: scenario id (the ``healthy``-key in-band pattern extended to
+#: ``scenario``);
+#: ``scenario_strata_draws`` — sampled batches drawn under a
+#: NON-uniform scenario mix (per-scenario strata shaping the draw; a
+#: uniform mix never counts here — it is byte-identical to the
+#: scenario-less draw stream by contract);
+#: ``scenario_serve_requests`` — scenario-labelled serve replies
+#: recorded by a :class:`~blendjax.serve.gateway.ServeGateway` into
+#: its per-scenario request/latency records.
+SCENARIO_EVENTS = (
+    "scenario_samples", "scenario_pushes", "scenario_push_failures",
+    "scenario_applies", "scenario_reassignments",
+    "scenario_curriculum_updates", "scenario_mix_changes",
+    "scenario_rows_stamped", "scenario_strata_draws",
+    "scenario_serve_requests",
+)
+
+#: Canonical scenario-plane stage names (see docs/scenarios.md):
+#: ``scenario_sample`` (one seeded spec sample — param-dict build),
+#: ``scenario_push`` (one duplex send of a sampled param push into a
+#: producer, bounded by the push timeout), ``scenario_reweight`` (one
+#: curriculum reweight pass: strata scrape fold + mix decision).
+SCENARIO_STAGES = (
+    "scenario_sample", "scenario_push", "scenario_reweight",
+)
+
 #: Canonical weight-bus stage names (see docs/weight_bus.md):
 #: ``weight_publish`` (snapshot + digest + chunk + stream, publisher
 #: side), ``weight_assemble`` (chunk ingest + digest verification per

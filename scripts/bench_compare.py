@@ -65,6 +65,11 @@ DEFAULT_FLOORS = {
     # live weight rollouts must stay ~free for serving traffic: QPS in
     # the buckets around a hot-swap over steady state (docs/weight_bus.md)
     "weight_swap_qps_dip_x": 0.80,
+    # heterogeneous 2-scenario fleet (ready-first) over the lock-step
+    # homogeneous batch path — the scenario plane's throughput claim
+    # (docs/scenarios.md); the absolute ratio scales with the
+    # fast/slow physics gap, so guard the trajectory, not a constant
+    "scenario_hetero_x": 0.80,
 }
 
 #: metric -> maximum acceptable new/old ratio for LOWER-is-better
@@ -77,6 +82,10 @@ DEFAULT_CEILINGS = {
     # millisecond tail measured over ~8 swaps, so the noise slack is
     # wider than the steady p99 ceilings
     "weight_swap_ms": 1.50,
+    # union client-observed p99 under the labelled multi-scenario
+    # traffic mix (docs/scenarios.md) — same slack as the single-shape
+    # serve tail
+    "serve_mix_p99_ms": 1.30,
 }
 
 #: fallback floor for numeric metrics named via --metrics that have no
@@ -147,6 +156,12 @@ def _flatten(doc, metrics):
             if isinstance(wb.get(k), (int, float)) \
                     and not isinstance(wb.get(k), bool):
                 metrics[k] = float(wb[k])
+    sc = doc.get("scenario_bench")
+    if isinstance(sc, dict):
+        for k in ("scenario_hetero_x", "serve_mix_p99_ms"):
+            if isinstance(sc.get(k), (int, float)) \
+                    and not isinstance(sc.get(k), bool):
+                metrics[k] = float(sc[k])
 
 
 def _regex_salvage(text, metrics):

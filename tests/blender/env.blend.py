@@ -25,12 +25,22 @@ class EchoEnv(BaseEnv):
     spinning: in deployment the solver burns a *producer host's* CPU,
     not the consumer's, so on a small CI box a spin here would measure
     core oversubscription instead of the per-frame latency the RL
-    benchmark is about."""
+    benchmark is about.
 
-    def __init__(self, agent, physics_us=0):
+    Scenario plane (docs/scenarios.md): ``--scenario`` labels the env
+    from launch, and the ``_env_apply_params`` hook — mirroring the
+    reference's densityopt receiver — applies mid-training pushes from
+    the CTRL duplex channel (``scenario`` relabel + ``physics_us``
+    retiming take effect on the next frame).  The applied scenario name
+    is echoed in every post-step dict, so the consumer's transitions,
+    replay rows and telemetry attribute to scenarios in-band."""
+
+    def __init__(self, agent, physics_us=0, scenario=None):
         super().__init__(agent)
         self.applied = 0.0
         self.physics_us = physics_us
+        self.scenario = scenario
+        self.params_applied = 0
 
     def _env_reset(self):
         self.applied = 0.0
@@ -42,12 +52,27 @@ class EchoEnv(BaseEnv):
 
             time.sleep(self.physics_us / 1e6)
 
+    def _env_apply_params(self, msg):
+        if msg.get("cmd") != "scenario":
+            return
+        params = msg.get("params") or {}
+        if "physics_us" in params:
+            self.physics_us = int(params["physics_us"])
+        name = msg.get("scenario") or params.get("scenario")
+        if name:
+            self.scenario = str(name)
+        self.params_applied += 1
+
     def _env_post_step(self):
-        return {
+        out = {
             "obs": self.applied,
             "reward": self.applied / 10.0,
             "frame": self.events.frameid,
         }
+        if self.scenario is not None:
+            out["scenario"] = self.scenario
+            out["physics_us_now"] = self.physics_us
+        return out
 
 
 def main():
@@ -55,10 +80,20 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--horizon", type=int, default=10)
     parser.add_argument("--physics-us", type=int, default=0)
+    parser.add_argument("--scenario", type=str, default=None)
     args = parser.parse_args(remainder)
 
     agent = RemoteControlledAgent(btargs.btsockets["GYM"], timeoutms=30000)
-    env = EchoEnv(agent, physics_us=args.physics_us)
+    env = EchoEnv(agent, physics_us=args.physics_us,
+                  scenario=args.scenario)
+    if "CTRL" in btargs.btsockets:
+        # the scenario control plane: a bound PAIR socket polled every
+        # frame, applying randomization pushes mid-training
+        from blendjax.btb.duplex import DuplexChannel
+
+        env.attach_param_channel(
+            DuplexChannel(btargs.btsockets["CTRL"], btid=btargs.btid)
+        )
     env.run(frame_range=(1, args.horizon), use_animation=False)
 
 

@@ -298,7 +298,19 @@ class TestMultiFleetTraining:
                 while min(al._env_steps_by_fleet) < 16:
                     time.sleep(0.02)
                 marks["steps_at_kill"] = al._env_steps_by_fleet[1]
-                kill_instance(fs.launchers[1], 0)
+                # the supervisor can heal a respawned producer so fast
+                # that the actor's in-flight retry SUCCEEDS against the
+                # new incarnation and the fleet never dies at all (the
+                # system winning a race this test is not about) — re-kill
+                # until the actor-death -> restart path actually engages
+                for _ in range(5):
+                    kill_instance(fs.launchers[1], 0)
+                    deadline = time.monotonic() + 4
+                    while time.monotonic() < deadline:
+                        if al._actor_errors[1] is not None \
+                                or al._fleet_restarts[1] >= 1:
+                            return
+                        time.sleep(0.05)
 
             result = {}
 

@@ -40,6 +40,8 @@ from blendjax.utils.timing import (
     GATEWAY_STAGES,
     REPLAY_EVENTS,
     REPLAY_STAGES,
+    SCENARIO_EVENTS,
+    SCENARIO_STAGES,
     SERVE_EVENTS,
     SERVE_STAGES,
     WEIGHT_EVENTS,
@@ -210,10 +212,10 @@ def test_scrape_zero_fill_contract():
     hub.register("fresh", counters=EventCounters(), timer=StageTimer())
     snap = hub.scrape()
     for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS \
-            + GATEWAY_EVENTS + WEIGHT_EVENTS:
+            + GATEWAY_EVENTS + WEIGHT_EVENTS + SCENARIO_EVENTS:
         assert snap["counters"][name] == 0, name
     for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES \
-            + GATEWAY_STAGES + WEIGHT_STAGES:
+            + GATEWAY_STAGES + WEIGHT_STAGES + SCENARIO_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
@@ -222,7 +224,10 @@ def test_scrape_zero_fill_contract():
     assert 'blendjax_events_total{event="quarantines"} 0' in prom
     assert 'blendjax_events_total{event="serve_cache_hits"} 0' in prom
     assert 'blendjax_events_total{event="weight_adopted"} 0' in prom
+    assert 'blendjax_events_total{event="scenario_pushes"} 0' in prom
     assert ('blendjax_stage_latency_seconds{stage="weight_swap",'
+            'quantile="0.99"} 0') in prom
+    assert ('blendjax_stage_latency_seconds{stage="scenario_push",'
             'quantile="0.99"} 0') in prom
     assert ('blendjax_stage_latency_seconds{stage="shard_gather",'
             'quantile="0.99"} 0') in prom
@@ -773,6 +778,34 @@ def test_documented_weight_stages_exist_in_tuples():
         "## Stage vocabulary",
     )
     vocab = set(WEIGHT_STAGES)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_scenario_counters_exist_in_tuples():
+    """The scenario-plane vocabulary lock (ISSUE-14 tentpole): every
+    ``SCENARIO_EVENTS`` counter docs/scenarios.md tabulates exists in
+    the tuple and every tuple name is tabulated — both directions,
+    same contract as the other vocabularies."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "scenarios.md"),
+        "## Counter vocabulary",
+    )
+    vocab = set(SCENARIO_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_scenario_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "scenarios.md"),
+        "## Stage vocabulary",
+    )
+    vocab = set(SCENARIO_STAGES)
     missing = [n for n in names if n not in vocab]
     assert not missing, f"documented but not in tuples: {missing}"
     absent = [n for n in vocab if n not in set(names)]
