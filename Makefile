@@ -9,10 +9,10 @@ PYTHON ?= python
 # tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos chaos-replay blender-tests tpu-tests bench \
-	rlbench rlbench-sharded replaybench shmbench servebench \
-	gatewaybench weightbench scenariobench multichip dryrun benchdiff \
-	obsdemo
+.PHONY: test tier1 chaos chaos-replay chaos-learner blender-tests \
+	tpu-tests bench rlbench rlbench-sharded replaybench shmbench \
+	servebench gatewaybench weightbench scenariobench habench \
+	multichip dryrun benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -58,6 +58,19 @@ chaos-replay:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		BJX_POSTMORTEM_DIR=obs_artifacts \
 		$(PYTHON) -m pytest tests/test_replay_service.py -m chaos -q -rs
+
+# The learner-failover chaos pack (tests/test_ha.py): SIGKILL the
+# supervised learner process mid-training (live fake-Blender fleet +
+# sharded replay + a subscribed serve replica) -> watchdog respawn ->
+# resume from the latest complete manifest with the replay draw
+# authority reconciled to the cut, weight-bus versions strictly
+# monotonic across the respawn, and zero serve-client errors.  Includes
+# the `slow`-marked full acceptance that tier-1 skips.  See
+# docs/fault_tolerance.md "Learner failover".
+chaos-learner:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		BJX_POSTMORTEM_DIR=obs_artifacts \
+		$(PYTHON) -m pytest tests/test_ha.py -m chaos -q -rs
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.
@@ -196,6 +209,18 @@ weightbench:
 scenariobench:
 	env -u PALLAS_AXON_POOL_IPS $(PYTHON) benchmarks/scenario_benchmark.py \
 		--seconds 20 --instances 2 --clients 6
+
+# Learner-failover microbench (docs/fault_tolerance.md "Learner
+# failover"): ckpt_overhead_x (off-policy update throughput with the
+# async TrainCheckpointer on vs off, interleaved window pairs — target
+# ~1.0, floor 0.90) and learner_recovery_s (SIGKILL of the supervised
+# learner process on a live fake-Blender fleet -> first completed
+# post-respawn update, watchdog + respawn + jax import + manifest
+# restore + first jitted update included).  One JSON line, both carried
+# in the bench.py headline with bench_compare bounds.
+habench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) benchmarks/ha_benchmark.py
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

@@ -438,12 +438,30 @@ def main():
         )
         scenario_bench = sc_lines[-1] if sc_lines else None
 
+    # ninth configuration: the learner-failover plane
+    # (docs/fault_tolerance.md "Learner failover") — ckpt_overhead_x
+    # (async TrainCheckpointer on vs off over interleaved run_offline
+    # windows) and learner_recovery_s (supervised learner SIGKILL ->
+    # first post-respawn completed update).
+    ha_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 40:
+        ha_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "ha_benchmark.py"),
+            ],
+            rl_env,
+            min(150, remaining),
+        )
+        ha_bench = ha_lines[-1] if ha_lines else None
+
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
                    replay_bench=replay_bench, rl_sharded=rl_sharded,
                    serve_bench=serve_bench, gateway_bench=gateway_bench,
                    weight_bench=weight_bench,
-                   scenario_bench=scenario_bench)
+                   scenario_bench=scenario_bench, ha_bench=ha_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -487,6 +505,7 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
+    ("ckpt_overhead_x", "learner_recovery_s"),
     ("scenario_hetero_x", "serve_mix_p99_ms"),
     ("weight_swap_ms", "weight_swap_qps_dip_x"),
     ("serve_int8_x",),
@@ -589,6 +608,15 @@ def headline(out):
         line["scenario_hetero_x"] = sc["scenario_hetero_x"]
         if sc.get("serve_mix_p99_ms") is not None:
             line["serve_mix_p99_ms"] = sc["serve_mix_p99_ms"]
+    ha = out.get("ha_bench")
+    if ha:
+        # the learner-failover headline: async-checkpointing overhead
+        # (~1.0 = the update loop pays only the bounded barrier) and
+        # the SIGKILL -> first-post-respawn-update outage
+        if ha.get("ckpt_overhead_x") is not None:
+            line["ckpt_overhead_x"] = ha["ckpt_overhead_x"]
+        if ha.get("learner_recovery_s") is not None:
+            line["learner_recovery_s"] = ha["learner_recovery_s"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -642,7 +670,7 @@ def headline(out):
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
              rl_sharded=None, serve_bench=None, gateway_bench=None,
-             weight_bench=None, scenario_bench=None):
+             weight_bench=None, scenario_bench=None, ha_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -691,6 +719,21 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
                 "scenario_counters", "serve_mix", "serve_mix_p99_ms",
             )
             if k in scenario_bench
+        }
+    if ha_bench and ha_bench.get("phase") == "ha_bench":
+        # the learner-failover record: async-checkpointing overhead
+        # pairs + the SIGKILL recovery drill — see
+        # benchmarks/ha_benchmark.py
+        extras["ha_bench"] = {
+            k: ha_bench[k]
+            for k in (
+                "window_s", "rounds", "ckpt_every_s",
+                "ckpt_on_updates_per_sec", "ckpt_off_updates_per_sec",
+                "ckpt_overhead_x", "pair_ratios",
+                "learner_recovery_s", "recovery", "ha_counters",
+                "stages",
+            )
+            if k in ha_bench
         }
     if weight_bench and weight_bench.get("phase") == "weight_bench":
         # the live-rollout cost record: publish -> first-serving-reply

@@ -150,6 +150,25 @@ SCENARIO_BENCH_KEYS = (
 )
 
 
+#: Result-schema keys every ``ha_benchmark.py`` JSON line carries
+#: (phase ``ha_bench``); ``bench.py`` keys off these and
+#: ``tests/test_ha.py`` locks emission against this tuple.
+#: ``ckpt_overhead_x`` is update throughput with the async
+#: TrainCheckpointer attached over checkpointing off (target ~1.0 —
+#: the bounded-stall contract, floor 0.90); ``learner_recovery_s`` is
+#: SIGKILL -> first completed post-respawn update of the supervised
+#: learner process (lower-is-better, ceiling-guarded on the
+#: trajectory).
+HA_BENCH_KEYS = (
+    "window_s", "rounds", "ckpt_every_s", "batch",
+    "ckpt_on_updates_per_sec", "ckpt_off_updates_per_sec",
+    "ckpt_overhead_x", "pair_ratios",
+    "learner_recovery_s", "recovery",
+    "ha_counters",
+    "stages",            # ha_snapshot / ha_serialize summaries
+)
+
+
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)
 

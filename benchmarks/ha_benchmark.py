@@ -1,0 +1,251 @@
+"""Learner-failover microbench (docs/fault_tolerance.md "Learner
+failover"): the two numbers the HA plane owes the headline.
+
+- ``ckpt_overhead_x`` — off-policy update throughput WITH the async
+  :class:`~blendjax.ha.checkpoint.TrainCheckpointer` attached over the
+  same learner with checkpointing off, interleaved window pairs, median
+  ratio.  The checkpointer's contract is that the synchronous barrier
+  (host-gather + replay cut) is the ONLY stall it charges the update
+  loop — serialization rides a background thread and due checkpoints
+  are skipped rather than queued — so the target is ~1.0 (floor 0.90
+  in bench_compare).
+- ``learner_recovery_s`` — SIGKILL of a supervised ``python -m
+  blendjax.ha.learner`` process (training a live fake-Blender fleet,
+  checkpointing every K updates) to the first COMPLETED post-respawn
+  update, as observed through the stats mirror.  Includes the watchdog
+  detection, the respawn, the child's jax import, the manifest restore
+  and the first jitted update — the real end-to-end outage a learner
+  death costs.  Guarded as a lower-is-better ceiling (1.50) on the
+  trajectory.
+
+One JSON line (phase ``ha_bench``; keys locked by
+``benchmarks/_common.HA_BENCH_KEYS``), carried into the ``bench.py``
+headline.  Run via ``make habench``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import statistics
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
+import numpy as np  # noqa: E402
+
+
+def _fill(buf, n, obs_dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        buf.append({
+            "obs": rng.standard_normal(obs_dim).astype(np.float32),
+            "action": np.int32(rng.integers(0, 3)),
+            "reward": np.float32(rng.standard_normal()),
+            "next_obs": rng.standard_normal(obs_dim).astype(np.float32),
+            "done": np.bool_(False),
+        })
+
+
+def measure_ckpt_overhead(window_s=1.5, rounds=4, ckpt_every_s=1.0,
+                          batch=32, capacity=4096, directory=None):
+    """Interleaved ckpt-on/ckpt-off ``run_offline`` windows over twin
+    fleet-less learners; returns the ``ckpt_overhead_x`` record.
+
+    The checkpointer runs on its wall-clock cadence (``ckpt_every_s``,
+    the production shape — "every K updates or T seconds") rather than
+    a per-update count: the tiny bench policy updates in ~2 ms, so ANY
+    fixed update count would checkpoint orders of magnitude hotter
+    than a real deployment and measure the barrier, not the contract.
+    The barrier itself is reported under ``stages["ha_snapshot"]``
+    either way."""
+    from blendjax.ha import TrainCheckpointer
+    from blendjax.models.actor_learner import ActorLearner
+    from blendjax.replay import ReplayBuffer
+    from blendjax.utils.timing import EventCounters
+
+    own_dir = directory is None
+    directory = directory or tempfile.mkdtemp(prefix="bjx-habench-")
+    counters = EventCounters()
+    ckptr = TrainCheckpointer(
+        directory, every_updates=10 ** 9, every_seconds=ckpt_every_s,
+        counters=counters, stats_path=None,
+    )
+    learners = {}
+    for arm, ck in (("on", ckptr), ("off", None)):
+        buf = ReplayBuffer(capacity, seed=0)
+        _fill(buf, min(capacity, 2048))
+        learners[arm] = ActorLearner(
+            None, 4, 3, replay=buf, seed=0, checkpointer=ck,
+        )
+    chunk = 50
+    for arm in learners:  # warmup: jit compile + arena spin-up
+        learners[arm].run_offline(num_updates=8, batch_size=batch)
+
+    def window(arm):
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window_s:
+            learners[arm].run_offline(num_updates=chunk,
+                                      batch_size=batch)
+            n += chunk
+        return n / (time.perf_counter() - t0)
+
+    rates = {"on": [], "off": []}
+    pair_ratios = []
+    try:
+        for r in range(rounds):
+            # order-rotated so drift never lands on one arm
+            order = ("on", "off") if r % 2 == 0 else ("off", "on")
+            pair = {}
+            for arm in order:
+                pair[arm] = window(arm)
+                rates[arm].append(pair[arm])
+            pair_ratios.append(pair["on"] / pair["off"])
+        ckptr.join(timeout=30)
+    finally:
+        if own_dir:
+            shutil.rmtree(directory, ignore_errors=True)
+    return {
+        "ckpt_on_updates_per_sec": round(
+            statistics.median(rates["on"]), 2),
+        "ckpt_off_updates_per_sec": round(
+            statistics.median(rates["off"]), 2),
+        "ckpt_overhead_x": round(statistics.median(pair_ratios), 3),
+        "pair_ratios": [round(x, 3) for x in pair_ratios],
+        "ckpt_saves": counters.get("ha_ckpt_saves"),
+        "ckpt_skipped": counters.get("ha_ckpt_skipped"),
+        "stages": ckptr.timer.summary(),
+    }
+
+
+def measure_recovery(instances=2, ckpt_every=2, warm_updates=4,
+                     timeout_s=180.0):
+    """The SIGKILL drill: supervised learner on a live fake-Blender
+    fleet; returns the ``learner_recovery_s`` record."""
+    from blendjax.btt.launcher import BlenderLauncher
+    from blendjax.ha import LearnerProcess, LearnerSupervisor
+    from blendjax.utils.timing import EventCounters
+
+    os.environ.setdefault(
+        "BLENDJAX_BLENDER",
+        os.path.join(os.path.dirname(HERE), "tests", "helpers",
+                     "fake_blender.py"),
+    )
+    script = os.path.join(
+        os.path.dirname(HERE), "tests", "blender", "env.blend.py"
+    )
+    ckpt_dir = tempfile.mkdtemp(prefix="bjx-harecovery-")
+    counters = EventCounters()
+    start_port = 21000 + (os.getpid() * 53) % 18000
+    try:
+        with BlenderLauncher(
+            scene="", script=script, num_instances=instances,
+            named_sockets=["GYM"], background=True,
+            start_port=start_port,
+        ) as bl:
+            addrs = bl.launch_info.addresses["GYM"]
+            with LearnerProcess(
+                ckpt_dir=ckpt_dir, env_addresses=addrs, obs_dim=1,
+                num_actions=2, rollout_len=8, seed=1,
+                ckpt_every=ckpt_every, chunk_updates=2,
+                action_values=[0.0, 1.0],
+            ) as lp:
+                with LearnerSupervisor(
+                    lp, interval=0.2, counters=counters,
+                ) as sup:
+                    deadline = time.monotonic() + timeout_s
+                    while True:
+                        s = lp.read_stats() or {}
+                        if (s.get("updates", 0) >= warm_updates
+                                and s.get("last_ckpt_update", 0) >= 1):
+                            break
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                f"learner never warmed up: {s}"
+                            )
+                        time.sleep(0.1)
+                    pre = lp.read_stats()
+                    t_kill = time.monotonic()
+                    os.kill(lp.launch_info.processes[0].pid,
+                            signal.SIGKILL)
+                    while True:
+                        s = lp.read_stats() or {}
+                        if (s.get("pid") not in (None, pre["pid"])
+                                and s.get("updates", 0)
+                                > pre["updates"]):
+                            recovery_s = time.monotonic() - t_kill
+                            break
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                f"learner never recovered: {s}"
+                            )
+                        time.sleep(0.05)
+                    post = lp.read_stats()
+        return {
+            "learner_recovery_s": round(recovery_s, 2),
+            "recovery": {
+                "prekill_updates": pre["updates"],
+                "postkill_updates": post["updates"],
+                "resumed_from": post.get("resumed_from"),
+                "deaths": counters.get("ha_learner_deaths"),
+                "respawns": counters.get("ha_learner_respawns"),
+            },
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--window-s", type=float, default=1.5)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--ckpt-every-s", type=float, default=1.0)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--instances", type=int, default=2)
+    ap.add_argument("--skip-recovery", action="store_true")
+    ap.add_argument("--skip-overhead", action="store_true")
+    args = ap.parse_args(argv)
+
+    out = {
+        "phase": "ha_bench",
+        "window_s": args.window_s,
+        "rounds": args.rounds,
+        "ckpt_every_s": args.ckpt_every_s,
+        "batch": args.batch,
+        "ckpt_on_updates_per_sec": None,
+        "ckpt_off_updates_per_sec": None,
+        "ckpt_overhead_x": None,
+        "pair_ratios": None,
+        "learner_recovery_s": None,
+        "recovery": None,
+        "ha_counters": None,
+        "stages": None,
+    }
+    if not args.skip_overhead:
+        rec = measure_ckpt_overhead(
+            window_s=args.window_s,
+            rounds=args.rounds, ckpt_every_s=args.ckpt_every_s,
+            batch=args.batch,
+        )
+        out["ha_counters"] = {
+            "ha_ckpt_saves": rec.pop("ckpt_saves"),
+            "ha_ckpt_skipped": rec.pop("ckpt_skipped"),
+        }
+        out.update(rec)
+    if not args.skip_recovery:
+        out.update(measure_recovery(instances=args.instances,
+                                    ckpt_every=2))
+    print(json.dumps(out), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Learner failover (HA): the last single point of failure closed.
+
+Every other tier already survives a SIGKILL — fleets respawn and rejoin
+(:mod:`blendjax.btt.supervise`), replay shards restore crash-exact from
+spill (:mod:`blendjax.replay.service`), serve replicas respawn under the
+watchdog, a killed weight publisher is invisible to its clients — but
+the one process that OWNS the training run had no checkpoint, no resume
+and no supervisor.  This package adds all three:
+
+- :class:`~blendjax.ha.checkpoint.TrainCheckpointer` — a coordinated,
+  atomic, versioned snapshot of the whole learner-side state (TrainState
+  + update counter + curriculum + the replay client's draw authority +
+  the last published weight-bus version), taken asynchronously off the
+  update loop and committed by a manifest naming one consistent cut;
+- ``python -m blendjax.ha.learner`` — the supervised learner process
+  (:mod:`blendjax.ha.learner`): restores the latest complete manifest at
+  startup, republishes the checkpointed weights under a fresh higher
+  version id, and trains on;
+- :class:`~blendjax.ha.supervisor.LearnerSupervisor` /
+  :class:`~blendjax.ha.supervisor.LearnerProcess` — the launcher-
+  compatible surface ``FleetWatchdog(restart=True)`` respawns, with a
+  flight-recorder postmortem naming the dead learner.
+
+See docs/fault_tolerance.md "Learner failover".
+"""
+
+from blendjax.ha.checkpoint import (  # noqa: F401
+    MANIFEST_FORMAT,
+    TrainCheckpointer,
+    latest_manifest,
+    restore_replay,
+)
+from blendjax.ha.supervisor import (  # noqa: F401
+    LearnerProcess,
+    LearnerSupervisor,
+)
+
+__all__ = [
+    "MANIFEST_FORMAT",
+    "TrainCheckpointer",
+    "latest_manifest",
+    "restore_replay",
+    "LearnerProcess",
+    "LearnerSupervisor",
+]

@@ -169,7 +169,8 @@ class ActorLearner:
                  mesh=None, num_fleets=None,
                  replay=None, replay_ratio=0, replay_batch=64, hub=None,
                  weight_bus=None, publish_every=1,
-                 scenarios=None, curriculum=None, fanin_min_ready=None):
+                 scenarios=None, curriculum=None, fanin_min_ready=None,
+                 checkpointer=None):
         self.pools = _as_pools(pool)
         if num_fleets is not None:
             if self.pools and num_fleets != len(self.pools):
@@ -299,6 +300,16 @@ class ActorLearner:
         )
         self.weight_bus = weight_bus
         self.publish_every = max(1, int(publish_every))
+        #: last version id this learner published on the bus (None
+        #: before the first publish) — checkpointed so a restored
+        #: learner's resume republish provably rolls the serve tier
+        #: FORWARD past it (docs/fault_tolerance.md "Learner failover")
+        self.last_published_version = None
+        #: coordinated train-state checkpointing (blendjax.ha): one
+        #: maybe_checkpoint per completed update, from the learner
+        #: thread — the synchronous barrier the checkpointer charges
+        #: training is bounded and measured (``ha_snapshot``)
+        self.checkpointer = checkpointer
         #: scenario plane (docs/scenarios.md); None = plane off, and
         #: every scenario-aware branch below is skipped — plane-off
         #: runs stay byte-identical to pre-scenario builds
@@ -349,6 +360,7 @@ class ActorLearner:
             for name, comp in (
                 ("scenario_randomizer", self.randomizer),
                 ("scenario_curriculum", self.curriculum),
+                ("ha_checkpointer", self.checkpointer),
             ):
                 if comp is None:
                     continue
@@ -393,6 +405,64 @@ class ActorLearner:
                 out["scenario_mix"] = self.curriculum.mix()
         return out
 
+    # -- learner failover (blendjax.ha; docs/fault_tolerance.md) -------------
+
+    def checkpoint_state(self):
+        """The learner-side scalars one coordinated checkpoint records
+        next to the TrainState: update counter, seed, the last
+        published bus version, curriculum state and scenario
+        assignments.  Everything JSON-able — it rides inline in the
+        :class:`~blendjax.ha.checkpoint.TrainCheckpointer` manifest."""
+        aux = {
+            "updates": self._updates_done,
+            "seed": self._seed,
+            "last_published_version": self.last_published_version,
+        }
+        if self.curriculum is not None:
+            aux["curriculum"] = self.curriculum.state_dict()
+        if self.randomizer is not None:
+            aux["scenario_assignments"] = self.randomizer.assignments
+        return aux
+
+    def load_checkpoint_state(self, state, aux):
+        """Apply a restored TrainState + :meth:`checkpoint_state` dict:
+        the update counter continues from the cut (the weight bus's
+        ``step`` stamps and the checkpoint cadence both key off it),
+        the actors' sampling snapshot is rebuilt from the restored
+        params, the curriculum resumes mid-interval, and — when the
+        scenario plane is attached — the checkpointed per-fleet
+        assignment is re-pushed into the producers over the existing
+        :meth:`~blendjax.scenario.randomize.DomainRandomizer.
+        apply_assignment` path (the respawned learner's fleets must
+        not keep serving the default scene)."""
+        self.state = state
+        if self._actor_device is not None:
+            self._actor_params = jax.tree.map(
+                jnp.asarray, jax.device_get(state.params)
+            )
+        else:
+            self._actor_params = state.params
+        self._updates_done = int(aux.get("updates", 0))
+        self.last_published_version = aux.get("last_published_version")
+        seed = aux.get("seed")
+        if seed is not None and int(seed) != self._seed:
+            # the manifest's seed is authoritative: the actor rollout
+            # RNG folds in self._seed at thread start, so keeping a
+            # mismatched constructor seed would silently diverge the
+            # action-sampling stream from the checkpointed run
+            log.warning(
+                "restoring checkpoint cut under seed %d into a learner "
+                "constructed with seed %d; adopting the checkpoint's "
+                "seed so the actor sampling streams continue the "
+                "checkpointed run", int(seed), self._seed,
+            )
+            self._seed = int(seed)
+        if self.curriculum is not None and aux.get("curriculum"):
+            self.curriculum.load_state_dict(aux["curriculum"])
+        assignment = aux.get("scenario_assignments")
+        if self.randomizer is not None and assignment:
+            self.randomizer.apply_assignment(list(assignment))
+
     @property
     def _env_steps(self):
         return sum(self._env_steps_by_fleet)
@@ -427,7 +497,7 @@ class ActorLearner:
         if self.weight_bus is not None \
                 and self._updates_done % self.publish_every == 0:
             try:
-                self.weight_bus.publish(
+                self.last_published_version = self.weight_bus.publish(
                     # reuse the mesh path's host gather; single-device
                     # params gather here (the only transfer they pay)
                     host if host is not None
@@ -438,6 +508,11 @@ class ActorLearner:
                 log.exception("weight bus publish failed (training "
                               "continues; the serve tier keeps its "
                               "last good version)")
+        if self.checkpointer is not None:
+            # once per completed update (on- AND off-policy — whatever
+            # advanced the params), same as the bus: the checkpointer
+            # decides cadence itself and never raises into the loop
+            self.checkpointer.maybe_checkpoint(self)
 
     # -- actor side ----------------------------------------------------------
 

@@ -8,10 +8,11 @@ snapshot:
 
 - :meth:`TelemetryHub.scrape` — a JSON-able dict with every canonical
   counter (``FLEET_EVENTS`` + ``REPLAY_EVENTS`` + ``SERVE_EVENTS`` +
-  ``GATEWAY_EVENTS`` + ``WEIGHT_EVENTS`` + ``SCENARIO_EVENTS``) and
+  ``GATEWAY_EVENTS`` + ``WEIGHT_EVENTS`` + ``SCENARIO_EVENTS`` +
+  ``HA_EVENTS``) and
   every canonical stage (``FEED_STAGES`` + ``REPLAY_STAGES`` +
   ``SERVE_STAGES`` + ``GATEWAY_STAGES`` + ``WEIGHT_STAGES`` +
-  ``SCENARIO_STAGES``)
+  ``SCENARIO_STAGES`` + ``HA_STAGES``)
   **zero-filled** (the same
   contract ``FleetSupervisor.health()`` keeps: dashboards and tests
   need no existence checks), histograms merged across components so the
@@ -52,7 +53,8 @@ def _canonical_counters():
 
     return (timing.FLEET_EVENTS + timing.REPLAY_EVENTS
             + timing.SERVE_EVENTS + timing.GATEWAY_EVENTS
-            + timing.WEIGHT_EVENTS + timing.SCENARIO_EVENTS)
+            + timing.WEIGHT_EVENTS + timing.SCENARIO_EVENTS
+            + timing.HA_EVENTS)
 
 
 def _canonical_stages():
@@ -60,7 +62,8 @@ def _canonical_stages():
 
     return (timing.FEED_STAGES + timing.REPLAY_STAGES
             + timing.SERVE_STAGES + timing.GATEWAY_STAGES
-            + timing.WEIGHT_STAGES + timing.SCENARIO_STAGES)
+            + timing.WEIGHT_STAGES + timing.SCENARIO_STAGES
+            + timing.HA_STAGES)
 
 
 def _zero_stage():

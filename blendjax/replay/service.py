@@ -52,7 +52,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -77,6 +77,13 @@ SHARD_FORMAT = "blendjax.replay.shard/1"
 #: written at open and rewritten at close (8 bytes/slot of header I/O
 #: per rotation).
 SPILL_CAPACITY = 65536
+
+#: Bound on the in-memory (seq, slot) tail mirror behind the
+#: ``written_since`` RPC.  At the cap, the oldest entry evicts and the
+#: tail's completeness floor rises to its seq — a query below the
+#: floor reports INCOMPLETE and the client rolls the whole shard range
+#: back instead of trusting a partial answer.
+TAIL_SLOTS_CAP = 65536
 
 
 class ReplayShard:
@@ -133,6 +140,20 @@ class ReplayShard:
         self.seq = 0
         self._last_ckpt_seq = 0
         self.restored_from = None  # (ckpt_seq, tail_records) after restore
+        #: (seq, slot) of recent appends — the in-memory mirror behind
+        #: the ``written_since`` RPC (learner-failover restore
+        #: reconciles a rewound client against the slots written past
+        #: its cut; see docs/fault_tolerance.md "Learner failover").
+        #: Retained ACROSS checkpoints — a client's cut can predate the
+        #: shard's latest checkpoint (the learner died between a
+        #: barrier's shard save and its manifest commit) and the query
+        #: must still answer.  ``_tail_floor`` is the durability cursor
+        #: the tail is complete back to: it rises only when the bounded
+        #: deque evicts (or on process restart, where appends before
+        #: the restored checkpoint are unknowable) — a query below the
+        #: floor is honestly incomplete instead of wrong.
+        self._tail_slots = deque()
+        self._tail_floor = 0
         self._spill = None
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
@@ -213,6 +234,9 @@ class ReplayShard:
             self.store.load_state_arrays(arrays)
             self.seq = int(meta["seq"])
             self._last_ckpt_seq = self.seq
+            # appends before the restored checkpoint left no tail
+            # record; the spill replay below re-adds everything newer
+            self._tail_floor = self.seq
         tail = 0
         for path in self._spill_paths():
             # scan, never FileReader: a killed shard's spill has an
@@ -223,6 +247,7 @@ class ReplayShard:
                     continue  # covered by the checkpoint
                 self.store.write_row(int(rec["slot"]), rec["row"])
                 self.seq = int(rec["seq"])
+                self._tail_note(int(rec["slot"]))
                 tail += 1
         if os.path.exists(ckpt) or tail:
             self.restored_from = (self._last_ckpt_seq, tail)
@@ -333,6 +358,7 @@ class ReplayShard:
         for slot, row in zip(slots, rows):
             self.store.write_row(int(slot), row)
             self.seq += 1
+            self._tail_note(int(slot))
             if self._spill is not None:
                 rec = {"slot": int(slot), "seq": self.seq, "row": row}
                 if not self._spill.save(rec):
@@ -390,6 +416,37 @@ class ReplayShard:
     def _cmd_save(self, msg):
         path = self.checkpoint()
         return {"path": path, "seq": self.seq}
+
+    def _tail_note(self, slot):
+        self._tail_slots.append((self.seq, slot))
+        if len(self._tail_slots) > TAIL_SLOTS_CAP:
+            evicted_seq, _ = self._tail_slots.popleft()
+            self._tail_floor = evicted_seq
+
+    def _cmd_written_since(self, msg):
+        """Slots this shard wrote after durability cursor ``seq`` —
+        the learner-failover reconcile query (a client restored from a
+        checkpoint cut at ``seq`` invalidates exactly these slots: they
+        hold rows its rewound draw state does not describe, and the
+        resumed appends will rewrite them in the same ring order).
+        The tail survives checkpoints — a cut can legitimately predate
+        the shard's LATEST checkpoint when the learner died between a
+        barrier's shard save and its manifest commit.
+        ``complete=False`` when the tail cannot answer exactly (the cut
+        predates the bounded mirror's floor: eviction, or a process
+        restart whose pre-checkpoint appends are unknowable) — the
+        caller rolls the whole range back instead of trusting a
+        partial list."""
+        since = int(msg["seq"])
+        complete = since >= self._tail_floor
+        slots = sorted({
+            slot for q, slot in self._tail_slots if q > since
+        }) if complete else []
+        return {
+            "seq": self.seq,
+            "complete": bool(complete),
+            "slots": slots,
+        }
 
     def _cmd_telemetry(self, msg):
         """This process's telemetry in the TelemetryHub merge shape:

@@ -807,14 +807,29 @@ class ShardedReplay(ReplayBuffer):
     @classmethod
     def restore(cls, path, shards, *, counters=None, timer=None,
                 fault_policy=None, timeoutms=5000, allow_dead=True,
-                context=None):
+                context=None, reconcile=False):
         """Rebuild the sampling authority from :meth:`save` output over
         ``shards`` (typically the same deployment, restarted).  Each
         reachable shard's durability cursor must match what the
         checkpoint acked — a shard that restored different contents
         than this client state describes would serve wrong rows, so the
         mismatch raises instead.  Unreachable shards start quarantined
-        (``allow_dead``) and re-admit through the normal probe path."""
+        (``allow_dead``) and re-admit through the normal probe path.
+
+        ``reconcile=True`` is the **learner-failover** mode
+        (docs/fault_tolerance.md "Learner failover"): the shards
+        SURVIVED while their client died, so a shard legitimately sits
+        AHEAD of the checkpoint — the dead client appended rows after
+        the cut.  Each such shard is asked ``written_since(acked)`` and
+        exactly the slots written past the cut are invalidated
+        client-side (counted ``replay_shard_lost``): they hold rows the
+        rewound draw state does not describe, and the resumed actors
+        rewrite them in the same ring order — the *replayed* rung of
+        the recovery-semantics table.  A shard that cannot answer
+        exactly (tail rotated/overflowed past the cut) has its whole
+        range rolled back instead of trusting a partial list.  A shard
+        BEHIND the checkpoint still raises — that is real data loss,
+        not a rewound client."""
         from blendjax.utils.checkpoint import load_state
 
         arrays, meta = load_state(path)
@@ -863,13 +878,18 @@ class ShardedReplay(ReplayBuffer):
                 buf._acked[s] = max(buf._acked[s], acked[s])
                 continue
             shard_seq = buf._acked[s]  # hello's cursor from __init__
+            if shard_seq > acked[s] and reconcile:
+                buf._reconcile_ahead_shard(s, acked[s])
+                continue
             if shard_seq != acked[s]:
                 raise RuntimeError(
                     f"{buf.name}: shard {s} is at seq {shard_seq} but "
                     f"the checkpoint acked {acked[s]} — restore the "
                     "shard from its matching snapshot before restoring "
-                    "the client, or it would serve rows the draw state "
-                    "does not describe"
+                    "the client (or pass reconcile=True for the "
+                    "learner-failover case of a live shard ahead of a "
+                    "rewound client), or it would serve rows the draw "
+                    "state does not describe"
                 )
         for s in meta_dead:
             with buf._cond:
@@ -877,6 +897,55 @@ class ShardedReplay(ReplayBuffer):
                     int(s), reason="quarantined at checkpoint time"
                 )
         return buf
+
+    def _reconcile_ahead_shard(self, s, acked_at_cut):
+        """Restore-time reconcile of a live shard AHEAD of the client
+        checkpoint (see :meth:`restore` ``reconcile=``): invalidate the
+        slots written past the cut so the rewound draw state never
+        gathers rows it does not describe."""
+        lo, hi = self._shard_slice(s)
+        reply = self.clients[s].rpc(
+            "written_since", {"seq": int(acked_at_cut)}
+        )
+        if reply["complete"]:
+            targets = [
+                lo + int(slot) for slot in reply["slots"]
+                if 0 <= int(slot) < self.shard_capacity
+            ]
+            reason = f"{len(targets)} slots written past the cut"
+        else:
+            targets = list(range(lo, hi))
+            reason = (
+                "tail rotated/overflowed past the cut; whole range "
+                "rolled back"
+            )
+        with self._cond:
+            rolled = 0
+            for slot in targets:
+                if not self._valid[slot] or self._pending[slot]:
+                    continue
+                self._valid[slot] = False
+                self._num_valid -= 1
+                if self.tree is not None:
+                    self.tree.set(int(slot), 0.0)
+                rolled += 1
+            # the shard's post-cut rows ARE durable — the acked cursor
+            # tracks the shard's real seq so resumed appends stay in
+            # sync; only the DRAW domain rolled back to the cut
+            self._acked[s] = int(reply["seq"])
+        if rolled:
+            self.counters.incr("replay_shard_lost", rolled)
+        flight_recorder.note(
+            "replay_shard_reconciled", target=f"shard{s}",
+            rolled_back=rolled, acked_at_cut=int(acked_at_cut),
+            shard_seq=int(reply["seq"]), buffer=self.name,
+        )
+        logger.warning(
+            "%s: shard %d reconciled ahead of the checkpoint cut "
+            "(seq %d > acked %d): %s; %d rows left the draw domain "
+            "until the resumed actors rewrite them", self.name, s,
+            int(reply["seq"]), int(acked_at_cut), reason, rolled,
+        )
 
     # -- observability -------------------------------------------------------
 

@@ -250,3 +250,58 @@ class CurriculumScheduler:
                 "updates": self._updates,
                 "mix_changes": self._changes,
             }
+
+    # -- checkpoint surface (learner failover; docs/fault_tolerance.md) ------
+
+    def state_dict(self):
+        """JSON-able snapshot of everything :meth:`tick` evolves —
+        policy, current/pinned mix, per-scenario return EMAs, and the
+        tick/update/change counters — so a restored learner's
+        curriculum continues from the cut instead of restarting at the
+        uniform mix (the interval gate included: a curriculum shift
+        due 3 updates after the cut stays due 3 updates after the
+        resume)."""
+        with self._lock:
+            return {
+                "names": list(self.names),
+                "policy": self.policy,
+                "mix": dict(self._mix),
+                "pinned": dict(self._pinned) if self._pinned else None,
+                "returns_ema": dict(self._returns),
+                "ticks": self._ticks,
+                "updates": self._updates,
+                "changes": self._changes,
+            }
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict` snapshot.  The scenario name
+        set must match — a checkpoint from a different catalog would
+        silently misweight fleets."""
+        names = list(state.get("names", []))
+        if names != self.names:
+            raise ValueError(
+                f"curriculum checkpoint spans scenarios {names}, this "
+                f"scheduler has {self.names}; restore with the same "
+                "catalog"
+            )
+        if state["policy"] not in POLICIES:
+            raise ValueError(
+                f"unknown curriculum policy {state['policy']!r} in "
+                f"checkpoint; one of {POLICIES}"
+            )
+        with self._lock:
+            self.policy = state["policy"]
+            self._mix = {n: float(state["mix"][n]) for n in self.names}
+            pinned = state.get("pinned")
+            self._pinned = (
+                {n: float(pinned[n]) for n in self.names}
+                if pinned else None
+            )
+            self._returns = {
+                n: float(v) for n, v in
+                (state.get("returns_ema") or {}).items()
+                if n in self.names
+            }
+            self._ticks = int(state.get("ticks", 0))
+            self._updates = int(state.get("updates", 0))
+            self._changes = int(state.get("changes", 0))

@@ -16,6 +16,7 @@ and has zero API churn.
 
 from __future__ import annotations
 
+import logging
 import os
 
 import numpy as np
@@ -25,9 +26,39 @@ import numpy as np
 # jax-free processes (the replay shard service, which checkpoints its
 # columns from a process that must start fast and never dial a device).
 
+logger = logging.getLogger("blendjax")
+
+
+def _replace_durable(tmp, path):
+    """``os.replace`` with the durability the atomic-rename idiom alone
+    does not buy: the tmp file's BYTES are fsynced before the rename
+    (an unsynced rename can survive a host crash as a complete-looking
+    zero-length/truncated file — the name committed, the data did not),
+    and the parent directory entry is fsynced after it (best-effort:
+    some filesystems refuse directory fsync)."""
+    fd = os.open(tmp, os.O_RDWR)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)),
+                      os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass  # the rename itself is durable-enough on refusal
+    finally:
+        os.close(dfd)
+
 
 def save_pytree(path, tree):
-    """Serialize a pytree of arrays to ``path`` (.npz, atomic rename)."""
+    """Serialize a pytree of arrays to ``path`` (.npz; fsync + atomic
+    rename, so a host crash leaves either the old file or the complete
+    new one — never a truncated impostor)."""
     import jax
 
     leaves = jax.tree_util.tree_leaves(tree)
@@ -35,7 +66,7 @@ def save_pytree(path, tree):
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
-    os.replace(tmp, path)
+    _replace_durable(tmp, path)
 
 
 def load_pytree(path, target):
@@ -87,7 +118,7 @@ def save_state(path, arrays, meta=None):
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
-    os.replace(tmp, path)
+    _replace_durable(tmp, path)
 
 
 def load_state(path):
@@ -130,15 +161,33 @@ class CheckpointManager:
         start = (mgr.latest_step() or -1) + 1      # resume loop
     """
 
-    def __init__(self, directory, max_to_keep=3, backend="npz"):
+    def __init__(self, directory, max_to_keep=3, backend="npz",
+                 counters=None):
         if backend not in ("npz", "orbax"):
             raise ValueError(f"unknown backend {backend!r}")
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         self.backend = backend
+        #: optional EventCounters sink (``ha_restore_fallbacks``); the
+        #: instance attribute below reports fallbacks either way
+        self.counters = counters
+        #: restores that fell back past an unloadable latest checkpoint
+        self.restore_fallbacks = 0
         os.makedirs(self.directory, exist_ok=True)
         if backend == "orbax":
-            import orbax.checkpoint as ocp
+            try:
+                import orbax.checkpoint as ocp
+            except ImportError as exc:
+                # surfaced at CONSTRUCTION, not mid-save: an absent
+                # optional dependency must fail before any training
+                # step trusts this manager with its state
+                raise ImportError(
+                    "CheckpointManager(backend='orbax') requires the "
+                    "optional 'orbax-checkpoint' package, which is not "
+                    "installed; pip install orbax-checkpoint, or use "
+                    "backend='npz' (the dependency-free default — "
+                    "sufficient for replicated/host-local states)"
+                ) from exc
 
             self._ckptr = ocp.PyTreeCheckpointer()
 
@@ -190,13 +239,59 @@ class CheckpointManager:
 
     def restore(self, template, step=None):
         """Restore ``step`` (default: latest) into ``template``'s
-        structure.  Raises FileNotFoundError when no checkpoint exists."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
+        structure.  Raises FileNotFoundError when no checkpoint exists.
+
+        With ``step=None`` an unloadable latest checkpoint (torn or
+        truncated by a host crash that outran the fsync of an older
+        writer, or deleted by a concurrent save's retention between the
+        listing and the open) FALLS BACK to the previous step — counted
+        in :attr:`restore_fallbacks` (and ``ha_restore_fallbacks`` when
+        a counter sink is attached) and warned, never silent; the
+        original error surfaces only when every step fails.  An
+        EXPLICIT ``step`` keeps the strict contract: its failure
+        raises."""
+        if step is not None:
+            return self._restore_step(template, step)
+        first_exc = None
+        for _attempt in range(8):
+            steps = self.all_steps()
+            if not steps and first_exc is None:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}"
                 )
+            for i, s in enumerate(reversed(steps)):
+                try:
+                    restored = self._restore_step(template, s)
+                except Exception as exc:  # noqa: BLE001 - fall back
+                    if first_exc is None:
+                        first_exc = exc
+                    self.restore_fallbacks += 1
+                    if self.counters is not None:
+                        self.counters.incr("ha_restore_fallbacks")
+                    logger.warning(
+                        "checkpoint step %d under %s failed to load "
+                        "(%s: %s); falling back to the previous step",
+                        s, self.directory, type(exc).__name__, exc,
+                    )
+                    continue
+                if i > 0 or _attempt > 0:
+                    logger.warning(
+                        "restored checkpoint step %d after newer "
+                        "step(s) failed to load", s,
+                    )
+                return restored
+            # every listed step failed: if the directory moved under
+            # us (a concurrent save's retention unlinked the step we
+            # just picked), re-list and retry instead of declaring the
+            # whole directory dead on a stale snapshot
+            if self.all_steps() == steps:
+                break
+        raise RuntimeError(
+            f"every checkpoint under {self.directory} failed to load; "
+            f"first error: {type(first_exc).__name__}: {first_exc}"
+        ) from first_exc
+
+    def _restore_step(self, template, step):
         path = self._path(step)
         if self.backend == "npz":
             return load_pytree(path, template)

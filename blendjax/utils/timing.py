@@ -278,6 +278,47 @@ SCENARIO_EVENTS = (
     "scenario_serve_requests",
 )
 
+#: Canonical learner-failover (HA) event names (see
+#: docs/fault_tolerance.md "Learner failover").  Same contract as
+#: ``FLEET_EVENTS``: any ``EventCounters`` accepts them and the
+#: TelemetryHub zero-fills every name in every scrape.
+#: ``ha_ckpt_saves`` — coordinated train-state checkpoints committed
+#: (manifest written: TrainState + counters + curriculum + replay cut
+#: + bus version form one consistent cut);
+#: ``ha_ckpt_bytes`` — bytes serialized into committed checkpoints;
+#: ``ha_ckpt_skipped`` — due checkpoints skipped because the previous
+#: background serialization was still in flight (the bounded-stall
+#: contract: the update loop never queues up checkpoint work);
+#: ``ha_ckpt_failures`` — checkpoint attempts that failed (counted and
+#: logged; never raised into the update loop);
+#: ``ha_ckpt_evicted`` — old checkpoints removed by retention;
+#: ``ha_restores`` — successful restores from a manifest;
+#: ``ha_restore_fallbacks`` — restores that fell back to an OLDER
+#: step/manifest because the latest failed to load (torn/truncated
+#: file after a host crash) — counted and warned, never silent;
+#: ``ha_learner_deaths`` — supervised learner-process deaths;
+#: ``ha_learner_respawns`` — successful supervised learner respawns;
+#: ``ha_resume_publishes`` — checkpointed params republished on the
+#: weight bus at resume under a fresh higher version id (the serve
+#: tier rolls forward across the respawn).
+HA_EVENTS = (
+    "ha_ckpt_saves", "ha_ckpt_bytes", "ha_ckpt_skipped",
+    "ha_ckpt_failures", "ha_ckpt_evicted",
+    "ha_restores", "ha_restore_fallbacks",
+    "ha_learner_deaths", "ha_learner_respawns", "ha_resume_publishes",
+)
+
+#: Canonical learner-failover stage names (see docs/fault_tolerance.md
+#: "Learner failover"): ``ha_snapshot`` (the synchronous barrier on the
+#: update loop — host-gather of the TrainState plus the coordinated
+#: replay cut; the only stall the checkpointer charges training),
+#: ``ha_serialize`` (background thread: npz writes + fsync + manifest
+#: commit + retention), ``ha_restore`` (manifest load + train-state /
+#: replay / curriculum restore at learner startup).
+HA_STAGES = (
+    "ha_snapshot", "ha_serialize", "ha_restore",
+)
+
 #: Canonical scenario-plane stage names (see docs/scenarios.md):
 #: ``scenario_sample`` (one seeded spec sample — param-dict build),
 #: ``scenario_push`` (one duplex send of a sampled param push into a

@@ -70,6 +70,10 @@ DEFAULT_FLOORS = {
     # (docs/scenarios.md); the absolute ratio scales with the
     # fast/slow physics gap, so guard the trajectory, not a constant
     "scenario_hetero_x": 0.80,
+    # async train-state checkpointing must stay ~free for the update
+    # loop: throughput with the TrainCheckpointer attached over
+    # checkpointing off (docs/fault_tolerance.md "Learner failover")
+    "ckpt_overhead_x": 0.90,
 }
 
 #: metric -> maximum acceptable new/old ratio for LOWER-is-better
@@ -86,6 +90,11 @@ DEFAULT_CEILINGS = {
     # traffic mix (docs/scenarios.md) — same slack as the single-shape
     # serve tail
     "serve_mix_p99_ms": 1.30,
+    # SIGKILL -> first completed post-respawn learner update: seconds,
+    # dominated by the child's jax import + first jitted update, so
+    # the slack is wide — the guard catches a recovery-path regression
+    # (e.g. an accidental full-buffer rewrite at restore), not noise
+    "learner_recovery_s": 1.50,
 }
 
 #: fallback floor for numeric metrics named via --metrics that have no

@@ -169,3 +169,92 @@ def test_quantized_and_rope_pytrees_roundtrip(tmp_path):
     obs = jnp.zeros((1, 8, 4), jnp.float32)
     out = seqformer.apply(restored, obs, compute_dtype=jnp.float32)
     assert out.shape == (1, 8, 4)
+
+
+def test_manager_torn_latest_falls_back_counted(tmp_path):
+    """ISSUE-15 satellite regression: a host crash can leave a
+    complete-LOOKING truncated .npz (the name renamed, the bytes never
+    synced — now prevented by fsync-before-rename, but older files and
+    other writers exist).  restore(step=None) must fall back to the
+    previous step, counted and warned, never silently die on the
+    latest; an EXPLICIT step keeps the strict raise."""
+    from blendjax.utils.checkpoint import CheckpointManager
+    from blendjax.utils.timing import EventCounters
+
+    counters = EventCounters()
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=3,
+                            counters=counters)
+    state = _tiny_state()
+    mgr.save(1, state)
+    mgr.save(2, state)
+    # tear the latest: truncated to a plausible-but-unloadable stub
+    with open(mgr._path(2), "r+b") as f:
+        f.truncate(12)
+    restored = mgr.restore(_tiny_state())
+    assert jax.tree.structure(restored) == jax.tree.structure(state)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored)[0]),
+        np.asarray(jax.tree.leaves(state)[0]),
+    )
+    assert mgr.restore_fallbacks == 1
+    assert counters.get("ha_restore_fallbacks") == 1
+    with pytest.raises(Exception):
+        mgr.restore(_tiny_state(), step=2)  # explicit step: strict
+    # every step torn -> the first error surfaces, never silence
+    with open(mgr._path(1), "r+b") as f:
+        f.truncate(12)
+    with pytest.raises(RuntimeError, match="every checkpoint"):
+        mgr.restore(_tiny_state())
+
+
+def test_manager_retention_racing_restore(tmp_path):
+    """ISSUE-15 satellite: _retain's unlink can delete the step a
+    concurrent reader just picked via latest_step().  restore(step=None)
+    must survive the race (re-list + fall back), and a vanished-file
+    window never surfaces as FileNotFoundError to the reader."""
+    import threading
+
+    from blendjax.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=1)
+    state = _tiny_state()
+    mgr.save(0, state)
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        template = _tiny_state()
+        try:
+            while not stop.is_set():
+                restored = mgr.restore(template)
+                assert jax.tree.structure(restored) \
+                    == jax.tree.structure(state)
+        except Exception as exc:  # noqa: BLE001 - the assertion subject
+            errors.append(f"{type(exc).__name__}: {exc}")
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    # max_to_keep=1: every save immediately unlinks the previous step
+    # the reader may have just picked
+    for step in range(1, 40):
+        mgr.save(step, state)
+    stop.set()
+    t.join(timeout=30)
+    assert errors == [], errors
+
+
+def test_manager_orbax_absent_actionable_import_error(tmp_path, monkeypatch):
+    """ISSUE-15 satellite: backend='orbax' without the package must be
+    an actionable ImportError at CONSTRUCTION (naming the pip package
+    and the npz fallback), not a traceback mid-save."""
+    import sys
+
+    from blendjax.utils.checkpoint import CheckpointManager
+
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    with pytest.raises(ImportError) as ei:
+        CheckpointManager(tmp_path / "ockpt", backend="orbax")
+    msg = str(ei.value)
+    assert "orbax-checkpoint" in msg
+    assert "backend='npz'" in msg

@@ -38,6 +38,8 @@ from blendjax.utils.timing import (
     FLEET_EVENTS,
     GATEWAY_EVENTS,
     GATEWAY_STAGES,
+    HA_EVENTS,
+    HA_STAGES,
     REPLAY_EVENTS,
     REPLAY_STAGES,
     SCENARIO_EVENTS,
@@ -212,10 +214,12 @@ def test_scrape_zero_fill_contract():
     hub.register("fresh", counters=EventCounters(), timer=StageTimer())
     snap = hub.scrape()
     for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS \
-            + GATEWAY_EVENTS + WEIGHT_EVENTS + SCENARIO_EVENTS:
+            + GATEWAY_EVENTS + WEIGHT_EVENTS + SCENARIO_EVENTS \
+            + HA_EVENTS:
         assert snap["counters"][name] == 0, name
     for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES \
-            + GATEWAY_STAGES + WEIGHT_STAGES + SCENARIO_STAGES:
+            + GATEWAY_STAGES + WEIGHT_STAGES + SCENARIO_STAGES \
+            + HA_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
@@ -225,6 +229,7 @@ def test_scrape_zero_fill_contract():
     assert 'blendjax_events_total{event="serve_cache_hits"} 0' in prom
     assert 'blendjax_events_total{event="weight_adopted"} 0' in prom
     assert 'blendjax_events_total{event="scenario_pushes"} 0' in prom
+    assert 'blendjax_events_total{event="ha_ckpt_saves"} 0' in prom
     assert ('blendjax_stage_latency_seconds{stage="weight_swap",'
             'quantile="0.99"} 0') in prom
     assert ('blendjax_stage_latency_seconds{stage="scenario_push",'
@@ -232,6 +237,8 @@ def test_scrape_zero_fill_contract():
     assert ('blendjax_stage_latency_seconds{stage="shard_gather",'
             'quantile="0.99"} 0') in prom
     assert ('blendjax_stage_latency_seconds{stage="queue_wait",'
+            'quantile="0.99"} 0') in prom
+    assert ('blendjax_stage_latency_seconds{stage="ha_snapshot",'
             'quantile="0.99"} 0') in prom
 
 
@@ -806,6 +813,34 @@ def test_documented_scenario_stages_exist_in_tuples():
         "## Stage vocabulary",
     )
     vocab = set(SCENARIO_STAGES)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_ha_counters_exist_in_tuples():
+    """The learner-failover vocabulary lock (ISSUE-15 tentpole): every
+    ``HA_EVENTS`` counter docs/fault_tolerance.md tabulates exists in
+    the tuple and every tuple name is tabulated — both directions,
+    same contract as the other vocabularies."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "fault_tolerance.md"),
+        "## HA counter vocabulary",
+    )
+    vocab = set(HA_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_ha_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "fault_tolerance.md"),
+        "## HA stage vocabulary",
+    )
+    vocab = set(HA_STAGES)
     missing = [n for n in names if n not in vocab]
     assert not missing, f"documented but not in tuples: {missing}"
     absent = [n for n in vocab if n not in set(names)]
