@@ -184,7 +184,8 @@ servebench:
 gatewaybench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		$(PYTHON) benchmarks/serve_benchmark.py \
-		--gateway --replicas 3 --seconds 18 --clients 16
+		--gateway --replicas 3 --gateway-workers 2 \
+		--client-procs 4 --seconds 27 --clients 16
 
 # WeightBus live-rollout microbench (docs/weight_bus.md): 6 concurrent
 # episode clients against one subscribed linear-model server while an

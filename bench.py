@@ -376,10 +376,16 @@ def main():
         )
         serve_bench = serve_lines[-1] if serve_lines else None
     # sixth configuration: the serve FLEET (docs/serving.md
-    # "ServeGateway") — 3 replica processes behind one gateway,
-    # interleaved 1-replica (drained) vs 3-replica windows:
-    # gateway_qps + gateway_p99_ms headline, gateway_scale_x the
-    # replica-level scale-out ratio.  Jax-free (linear replicas).
+    # "ServeGateway" + "The sharded gateway") — 3 replica processes
+    # behind the SHARDED gateway (2 worker processes + front), with
+    # interleaved 1-replica (drained) vs 3-replica windows
+    # (gateway_scale_x, replica scale-out, replica-bound fleet) AND a
+    # second phase of 1-worker (single-address relay) vs 2-worker
+    # (partitioned direct dial) windows over its own gateway-bound
+    # fleet (gateway_shard_x); bench clients ride their own processes
+    # (--client-procs) so their GIL never throttles the data plane.
+    # gateway_qps + gateway_p99_ms headline.  Jax-free (linear
+    # replicas).
     gateway_bench = None
     remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
     if remaining > 40:
@@ -388,11 +394,13 @@ def main():
                 sys.executable,
                 os.path.join(HERE, "benchmarks", "serve_benchmark.py"),
                 "--gateway", "--replicas", "3",
-                "--seconds", "15",
+                "--gateway-workers", "2",
+                "--client-procs", "4",
+                "--seconds", "27",
                 "--clients", "16",
             ],
             rl_env,
-            min(90, remaining),
+            min(150, remaining),
         )
         gateway_bench = gw_lines[-1] if gw_lines else None
 
@@ -516,7 +524,7 @@ HEADLINE_TRIM_ORDER = (
     ("gateway_qps", "gateway_p99_ms"),
     ("rl_sharded_x",),
     ("replay_sample_x",),
-    ("gateway_scale_x",),
+    ("gateway_scale_x", "gateway_shard_x"),
     ("serve_qps", "serve_p99_ms"),
     ("feed_arena_x",),
     ("rl_pipelined_x",),
@@ -593,6 +601,9 @@ def headline(out):
             line["gateway_p99_ms"] = gb["gateway_p99_ms"]
         if gb.get("gateway_scale_x") is not None:
             line["gateway_scale_x"] = gb["gateway_scale_x"]
+        if gb.get("gateway_shard_x") is not None:
+            # the sharded data plane's win: N gateway workers over one
+            line["gateway_shard_x"] = gb["gateway_shard_x"]
     wb = out.get("weight_bench")
     if wb and wb.get("weight_swap_ms") is not None:
         # the live-rollout headline: publish -> first serving reply at
@@ -699,9 +710,13 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
             k: gateway_bench[k]
             for k in (
                 "replicas", "clients", "work_us", "rounds", "window_s",
+                "gateway_workers", "client_procs",
                 "gateway_qps", "gateway_qps_1replica",
+                "gateway_qps_1worker", "gateway_qps_nworker",
+                "shard_profile",
                 "gateway_p50_ms", "gateway_p99_ms", "gateway_scale_x",
-                "pair_ratios", "gateway_counters", "stages",
+                "gateway_shard_x", "pair_ratios", "shard_pair_ratios",
+                "gateway_counters", "stages",
             )
             if k in gateway_bench
         }

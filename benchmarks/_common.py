@@ -84,14 +84,30 @@ SERVE_BENCH_KEYS = (
 #: gateway at N replicas over the SAME fleet with all but one replica
 #: drained, at the median interleaved window pair;
 #: ``gateway_qps``/``gateway_p99_ms`` are the N-replica aggregate rate
-#: and client-observed union p99.
+#: and client-observed union p99.  ``gateway_shard_x`` is the sharded
+#: data plane's win (``--gateway-workers N``): N-worker partitioned
+#: direct dial over the UNSHARDED single-address shape
+#: (``set_active_workers(1)`` — same worker processes, same front,
+#: but no direct-dial map: every message relays through the front's
+#: one event loop, the monolithic deployment shape) at the median
+#: same-round pair, measured over the shard phase's OWN gateway-bound
+#: fleet (``shard_profile``: light per-row work, fat observations) so
+#: the window exercises the data-plane hop rather than replica
+#: sleep-compute; None in 1-worker mode.  The scale pair stays on the
+#: replica-bound fleet, keeping ``gateway_qps``/``gateway_scale_x``
+#: comparable with pre-shard artifacts.
+#: ``client_procs`` records whether the window's bench clients ran as
+#: processes (``--client-procs``, GIL isolation) so before/after
+#: artifacts are comparable.
 GATEWAY_BENCH_KEYS = (
     "replicas", "clients", "obs_dim", "work_us", "rounds", "window_s",
     "episode_len",
-    "gateway_qps", "gateway_qps_1replica",
+    "gateway_workers", "client_procs",
+    "gateway_qps", "gateway_qps_1replica", "gateway_qps_1worker",
+    "gateway_qps_nworker", "shard_profile",
     "gateway_p50_ms", "gateway_p99_ms",
-    "gateway_scale_x",
-    "pair_ratios",
+    "gateway_scale_x", "gateway_shard_x",
+    "pair_ratios", "shard_pair_ratios",
     "gateway_counters",
     "stages",            # gw_route / gw_forward / gw_reply summaries
 )

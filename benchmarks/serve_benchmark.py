@@ -202,6 +202,13 @@ def _run_window(address, profiles, seconds, clients):
         think_s = prof.think_us / 1e6
         try:
             client.reset(scenario=prof.scenario)
+            # throwaway steps so transport negotiation (the shm
+            # upgrade probe — attach or permanent refusal, which
+            # triggers after UPGRADE_AFTER rpcs) settles BEFORE the
+            # clock: the window measures steady state, not
+            # first-contact channel churn
+            client.step(obs)
+            client.step(obs)
             ready.wait(timeout=30)
             go.wait(timeout=30)
             end = t_deadline[0]
@@ -425,65 +432,247 @@ def measure(seconds=12.0, clients=8, model="seqformer", *, obs_dim=8,
     return out
 
 
+def _client_proc_main(address, profiles, seconds, clients, ready, go,
+                      outq):
+    """Entry point of one ``--client-procs`` worker: runs a share of
+    the window's clients (threads) in its OWN process, so client-side
+    request encode/decode never contends with the front/gateway thread
+    for the parent's GIL.  Imports happen before the ready barrier, so
+    the measured windows align across processes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from blendjax.serve import client as _  # noqa: F401 - preimport
+
+        ready.wait(timeout=120)
+        go.wait(timeout=120)
+        qps, hist, _scen = _run_window(address, profiles, seconds,
+                                       clients)
+        outq.put(("ok", qps, hist.to_dict()))
+    except Exception as exc:  # noqa: BLE001 - surfaced in the parent
+        outq.put(("err", f"{type(exc).__name__}: {exc}", None))
+
+
+def _run_window_procs(address, profiles, seconds, clients, procs):
+    """``_run_window`` with the client threads spread over ``procs``
+    worker PROCESSES (spawn — never fork a process that holds live
+    server threads).  Same return shape; per-scenario breakdown is not
+    carried across the process boundary (the mix arm stays
+    in-process)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    shares = [clients // procs + (1 if i < clients % procs else 0)
+              for i in range(procs)]
+    shares = [s for s in shares if s]
+    ready = ctx.Barrier(len(shares) + 1)
+    go = ctx.Barrier(len(shares) + 1)
+    outq = ctx.Queue()
+    workers = [
+        ctx.Process(
+            target=_client_proc_main,
+            args=(address, profiles, seconds, share, ready, go, outq),
+            daemon=True,
+        )
+        for share in shares
+    ]
+    for w in workers:
+        w.start()
+    try:
+        ready.wait(timeout=180)
+        go.wait(timeout=60)
+        results = [outq.get(timeout=seconds + 180) for _ in workers]
+    finally:
+        for w in workers:
+            w.join(timeout=30)
+            if w.is_alive():
+                w.terminate()
+    errors = [r[1] for r in results if r[0] == "err"]
+    if errors:
+        raise RuntimeError(
+            f"bench client process(es) failed: {'; '.join(errors)}"
+        )
+    merged = LatencyHistogram()
+    for r in results:
+        merged.merge(LatencyHistogram.from_dict(r[2]))
+    return sum(r[1] for r in results), merged, {}
+
+
 def measure_gateway(seconds=18.0, clients=16, replicas=3, *, obs_dim=8,
                     work_us=2000, episode_len=32, rounds=3, slots=None,
-                    seed=0, tick_ms=1.0, scrape_interval_s=0.2):
+                    seed=0, tick_ms=1.0, scrape_interval_s=0.2,
+                    gateway_workers=1, client_procs=0,
+                    shard_work_us=500, shard_obs_dim=128,
+                    shard_clients=None):
     """The fleet bench: N linear-model replica processes behind one
-    in-process gateway, interleaved 1-replica (others DRAINED) vs
-    N-replica windows.  Returns the gateway_bench record."""
-    from blendjax.serve.gateway import start_gateway_thread
+    gateway, interleaved 1-replica (others DRAINED) vs N-replica
+    windows (``gateway_scale_x``).
+
+    ``gateway_workers > 1`` runs the SHARDED gateway (front + worker
+    processes + control plane, docs/serving.md) and ADDS a second
+    phase over its own fleet (``shard_work_us``/``shard_obs_dim`` —
+    a gateway-bound shape: light replica work, fat observations, so
+    the data-plane hop is what the window measures, not replica
+    sleep-compute): interleaved same-fleet pairs of the data plane
+    collapsed to the UNSHARDED single-address shape
+    (``set_active_workers(1)`` — same worker processes, same front,
+    but no direct-dial map: every message relays through the front's
+    one event loop onto one worker, which is what a monolithic
+    gateway deployment looks like to clients) vs full partitioned
+    direct dial.  ``gateway_shard_x`` is the N-worker/1-worker QPS
+    ratio at the median same-round pair, the data-plane sharding win
+    in isolation; the scale pair stays on the original replica-bound
+    fleet so ``gateway_qps``/``gateway_scale_x``/``gateway_p99_ms``
+    remain comparable with pre-shard artifacts.  ``client_procs > 0``
+    moves the window's client threads into that many processes (GIL
+    isolation on small CI boxes — the record carries the value so
+    before/after artifacts are comparable).  Returns the
+    gateway_bench record."""
+    from blendjax.serve.gateway import (
+        start_gateway_thread,
+        start_sharded_gateway_thread,
+    )
     from blendjax.serve.server import ServerFleet
     from blendjax.utils.timing import EventCounters, StageTimer
 
     replicas = int(replicas)
+    gateway_workers = max(1, int(gateway_workers))
+    client_procs = max(0, int(client_procs))
+    sharded = gateway_workers > 1
     slots = slots or max(2 * clients, 16)
-    window_s = max(0.5, seconds / (rounds * 2))
+    # the shard phase adds rounds*2 windows of its own, carved from the
+    # same wall budget so --seconds stays the honest total
+    windows_per_round = 4 if sharded else 2
+    window_s = max(0.5, seconds / (rounds * windows_per_round))
     counters, timer = EventCounters(), StageTimer()
     profile = RequestProfile(obs_dim, episode_len)
+
+    def mk_run(prof):
+        if client_procs:
+            return lambda addr, s: _run_window_procs(
+                addr, prof, s, clients, client_procs)
+        return lambda addr, s: _run_window(addr, prof, s, clients)
+
+    run = mk_run(profile)
     qps_one, qps_all = [], []
     all_hist = LatencyHistogram()
     with ServerFleet(replicas, model="linear", obs_dim=obs_dim,
                      slots=slots, seed=seed, tick_ms=tick_ms,
                      work_us=work_us) as fleet:
-        gw = start_gateway_thread(
-            fleet.addresses, counters=counters, timer=timer,
-            scrape_interval_s=scrape_interval_s,
-        )
+        if sharded:
+            gw = start_sharded_gateway_thread(
+                fleet.addresses, workers=gateway_workers,
+                counters=counters, timer=timer,
+                scrape_interval_s=scrape_interval_s,
+            )
+        else:
+            gw = start_gateway_thread(
+                fleet.addresses, counters=counters, timer=timer,
+                scrape_interval_s=scrape_interval_s,
+            )
         rest = [f"r{i}" for i in range(1, replicas)]
 
         def run_one():
             # drain everything but r0: same gateway, same sockets,
-            # same fleet — only the replica count differs
+            # same fleet — only the replica count differs.  Sharded:
+            # the drain flag reaches workers via the next control
+            # snapshot, so wait out a publish interval
             for rid in rest:
                 gw.gateway.drain(rid)
-            time.sleep(0.05)  # let in-flight resets settle
+            time.sleep(3 * scrape_interval_s if sharded else 0.05)
             try:
-                rate, _, _ = _run_window(gw.address, profile, window_s,
-                                         clients)
+                rate, _, _ = run(gw.address, window_s)
             finally:
                 for rid in rest:
                     gw.gateway.undrain(rid)
+                if sharded:
+                    time.sleep(3 * scrape_interval_s)
             return rate
 
         def run_all():
-            rate, hist, _ = _run_window(gw.address, profile, window_s,
-                                        clients)
+            rate, hist, _ = run(gw.address, window_s)
             all_hist.merge(hist)
             return rate
 
+        arms = [("one", run_one, qps_one), ("all", run_all, qps_all)]
         try:
             _run_window(gw.address, profile, 0.3, clients)
             for r in range(rounds):
-                if r % 2 == 0:
-                    qps_one.append(run_one())
-                    qps_all.append(run_all())
-                else:
-                    qps_all.append(run_all())
-                    qps_one.append(run_one())
+                rot = arms[r % len(arms):] + arms[:r % len(arms)]
+                for _name, fn, sink in rot:
+                    sink.append(fn())
         finally:
             gw.close()
+    # -- shard phase: 1-worker (single-address relay) vs N-worker
+    # (partitioned direct dial) over its OWN gateway-bound fleet —
+    # light replica work + fat observations so the window measures the
+    # data-plane hop, not replica sleep-compute (the scale pair above
+    # keeps the replica-bound fleet for artifact comparability)
+    qps_one_worker, qps_nworker = [], []
+    shard_counters = {}
+    if sharded:
+        # default caps the shard phase at 12 clients: on a small box
+        # more client threads saturate the core and flatten both arms
+        # to the same CPU ceiling, hiding the relay penalty
+        sclients = int(shard_clients or min(clients, 12))
+        sprofile = RequestProfile(shard_obs_dim, episode_len)
+        if client_procs:
+            srun = lambda addr, s: _run_window_procs(  # noqa: E731
+                addr, sprofile, s, sclients, client_procs)
+        else:
+            srun = lambda addr, s: _run_window(  # noqa: E731
+                addr, sprofile, s, sclients)
+        sslots = max(2 * sclients, 16)
+        with ServerFleet(replicas, model="linear",
+                         obs_dim=shard_obs_dim, slots=sslots,
+                         seed=seed, tick_ms=min(tick_ms, 0.5),
+                         work_us=shard_work_us) as sf:
+            sgw = start_sharded_gateway_thread(
+                sf.addresses, workers=gateway_workers,
+                counters=counters, timer=timer,
+                scrape_interval_s=scrape_interval_s,
+            )
+
+            def run_one_worker():
+                sgw.set_active_workers(1)
+                try:
+                    rate, _, _ = srun(sgw.address, window_s)
+                finally:
+                    sgw.set_active_workers(gateway_workers)
+                return rate
+
+            def run_nworker():
+                rate, _, _ = srun(sgw.address, window_s)
+                return rate
+
+            sarms = [("one_worker", run_one_worker, qps_one_worker),
+                     ("nworker", run_nworker, qps_nworker)]
+            try:
+                # warm BOTH plane shapes so neither timed arm pays
+                # first-contact channel negotiation (generous windows:
+                # the first measured pair is only as honest as the
+                # slowest path is warm)
+                _run_window(sgw.address, sprofile, 0.8, sclients)
+                sgw.set_active_workers(1)
+                _run_window(sgw.address, sprofile, 0.8, sclients)
+                sgw.set_active_workers(gateway_workers)
+                for r in range(rounds):
+                    rot = sarms[r % 2:] + sarms[:r % 2]
+                    for _name, fn, sink in rot:
+                        sink.append(fn())
+            finally:
+                shard_counters = sgw.gateway.gateway_counters()
+                sgw.close()
     pairs = [round(n / o, 3) for o, n in zip(qps_one, qps_all) if o]
+    shard_pairs = [round(n / o, 3)
+                   for o, n in zip(qps_one_worker, qps_nworker) if o]
     pct = all_hist.percentiles()
+    if sharded:
+        merged = dict(gw.gateway.gateway_counters())
+        for k, v in shard_counters.items():
+            if isinstance(v, (int, float)):
+                merged[k] = merged.get(k, 0) + v
+    else:
+        merged = counters.snapshot()
     return {
         "replicas": replicas,
         "clients": clients,
@@ -492,16 +681,36 @@ def measure_gateway(seconds=18.0, clients=16, replicas=3, *, obs_dim=8,
         "rounds": rounds,
         "window_s": round(window_s, 3),
         "episode_len": episode_len,
+        "gateway_workers": gateway_workers,
+        "client_procs": client_procs,
         "gateway_qps": round(float(np.median(qps_all)), 2),
         "gateway_qps_1replica": round(float(np.median(qps_one)), 2),
+        "gateway_qps_1worker": (
+            round(float(np.median(qps_one_worker)), 2)
+            if qps_one_worker else None
+        ),
+        "gateway_qps_nworker": (
+            round(float(np.median(qps_nworker)), 2)
+            if qps_nworker else None
+        ),
+        "shard_profile": (
+            {"work_us": shard_work_us, "obs_dim": shard_obs_dim,
+             "clients": int(shard_clients or min(clients, 12))}
+            if sharded else None
+        ),
         "gateway_p50_ms": pct["p50_ms"],
         "gateway_p99_ms": pct["p99_ms"],
         "gateway_scale_x": (
             round(float(np.median(pairs)), 3) if pairs else None
         ),
+        "gateway_shard_x": (
+            round(float(np.median(shard_pairs)), 3)
+            if shard_pairs else None
+        ),
         "pair_ratios": pairs,
+        "shard_pair_ratios": shard_pairs,
         "gateway_counters": {
-            k: v for k, v in counters.snapshot().items()
+            k: v for k, v in merged.items()
             if k.startswith("gateway_")
         },
         "stages": {
@@ -630,9 +839,30 @@ def main(argv=None):
                     help="fleet bench: N replica processes behind a "
                          "ServeGateway, 1-replica vs N-replica windows")
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--gateway-workers", type=int, default=1,
+                    help="gateway bench: >1 runs the SHARDED gateway "
+                         "(N worker processes behind one front) and "
+                         "adds interleaved 1-worker windows — "
+                         "gateway_shard_x at the median pair")
+    ap.add_argument("--client-procs", type=int, default=0,
+                    help="spread each window's bench clients over this "
+                         "many processes (0 = threads in-process); GIL "
+                         "isolation on small boxes, recorded in the "
+                         "artifact for before/after comparison")
     ap.add_argument("--work-us", type=float, default=2000,
                     help="gateway bench: per-row replica compute "
                          "stand-in (sleep-based, linear model)")
+    ap.add_argument("--shard-work-us", type=float, default=500,
+                    help="shard-phase fleet's per-row work (light, so "
+                         "the data-plane hop dominates the window)")
+    ap.add_argument("--shard-obs-dim", type=int, default=128,
+                    help="shard-phase fleet's observation width (fat, "
+                         "so the per-message wire cost is visible)")
+    ap.add_argument("--shard-clients", type=int, default=None,
+                    help="shard-phase client count (default: "
+                         "min(--clients, 12) — on small CI boxes more "
+                         "client threads just saturate the core and "
+                         "flatten both arms to the same CPU ceiling)")
     ap.add_argument("--scenario-mix", nargs="?", const=DEFAULT_MIX,
                     default=None, metavar="L:W[:EP[:THINK_US]],...",
                     help="labelled traffic-mix arm (docs/scenarios.md): "
@@ -662,6 +892,11 @@ def main(argv=None):
             replicas=args.replicas, obs_dim=args.obs_dim,
             work_us=args.work_us, episode_len=args.episode_len,
             rounds=args.rounds or 3, seed=args.seed,
+            gateway_workers=args.gateway_workers,
+            client_procs=args.client_procs,
+            shard_work_us=args.shard_work_us,
+            shard_obs_dim=args.shard_obs_dim,
+            shard_clients=args.shard_clients,
         )
         line = {
             "metric": "gateway_qps",

@@ -140,6 +140,13 @@ class BlenderLauncher:
 
         # 8 hex chars of urandom: unique per launch, shared by respawns
         self._nonce = os.urandom(4).hex()
+        #: per-launch /dev/shm base PREFIX (PR-12 ShmRPC hygiene
+        #: discipline): every shm object this launch creates — rings
+        #: and any side objects the ring layer names under them — sits
+        #: under one glob-able prefix, so teardown is one sweep instead
+        #: of per-address unlinks that miss what a SIGKILLed producer
+        #: half-created
+        self._shm_base = f"blendjax-{self._nonce}"
 
         self.blender_info = discover_blender(self.blend_path)
         if self.blender_info is None:
@@ -160,13 +167,15 @@ class BlenderLauncher:
     def _addresses(self):
         """One address per (socket name, instance), ports ascending.
 
-        shm names carry a per-launch nonce: addresses travel to producers
-        via ``-btsockets``, so no deterministic rendezvous is needed, and a
+        shm names live under the per-launch nonce'd base prefix
+        (``self._shm_base``): addresses travel to producers via
+        ``-btsockets``, so no deterministic rendezvous is needed, and a
         ring leaked by a previous run (SIGKILL teardown) can never be
         mistaken for this launch's ring — the stale-generation poisoning
         found in round 2 (VERDICT r2 weak #2).  Watchdog respawns reuse
         the original command line, hence the same nonce'd name, so the
-        reader's generation-reopen elasticity still works.
+        reader's generation-reopen elasticity still works; teardown
+        sweeps the whole prefix in one glob (see :meth:`_unlink_shm`).
         """
         bind = self.bind_addr
         if bind == "primaryip":
@@ -179,7 +188,7 @@ class BlenderLauncher:
                     addrs.append(f"ipc:///tmp/blendjax-{name}-{port + idx}.ipc")
                 elif self.proto == "shm":
                     addrs.append(
-                        f"shm://blendjax-{name}-{port + idx}-{self._nonce}"
+                        f"shm://{self._shm_base}-{name}-{port + idx}"
                     )
                 else:
                     addrs.append(f"{self.proto}://{bind}:{port + idx}")
@@ -188,20 +197,22 @@ class BlenderLauncher:
         return addresses
 
     def _unlink_shm(self, addresses=None):
-        """Remove this fleet's shm rings (teardown hygiene: a SIGKILLed
-        producer never runs its unlink path; without this every crash
-        strands capacity_bytes in /dev/shm)."""
-        if addresses is None:
-            addresses = (
-                self.launch_info.addresses if self.launch_info else None
-            )
-        if self.proto != "shm" or not addresses:
+        """Remove EVERY shm object under this launch's base prefix
+        (teardown hygiene: a SIGKILLed producer never runs its unlink
+        path; without this every crash strands capacity_bytes in
+        /dev/shm).  One ``unlink_base`` glob sweep — the PR-12 ShmRPC
+        discipline — instead of per-address unlinks, so side objects
+        named under a ring's prefix (bells, a half-created segment of
+        a crashed spawn) go with it.  The nonce'd base makes the glob
+        collision-proof against other launches."""
+        if self.proto != "shm":
             return
-        from blendjax.native.ring import unlink_address
+        from blendjax.btt.shm_rpc import unlink_base
 
-        for addrs in addresses.values():
-            for a in addrs:
-                unlink_address(a)
+        removed = unlink_base(self._shm_base)
+        if removed:
+            logger.debug("swept %d shm objects under %s",
+                         len(removed), self._shm_base)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -246,7 +257,7 @@ class BlenderLauncher:
         except Exception:
             for p in processes:
                 self._stop_process(p)
-            self._unlink_shm(addresses)
+            self._unlink_shm()
             raise
 
         self.launch_info = LaunchInfo(addresses, commands, processes=processes)

@@ -234,6 +234,11 @@ class ShmRpcServer:
         self._chan_seq = 0
         self._channels = {}  # name -> ServerChannel
         self._pending = {}   # name -> allocation awaiting shm_attach
+        #: channels with committed-but-unannounced records (batched
+        #: doorbell: a burst of co-admitted replies costs ONE wake per
+        #: channel, flushed by :meth:`flush_bells` at the end of the
+        #: burst instead of one ding per record)
+        self._deferred_bells = set()
         self.bell = DoorBell(f"/dev/shm/{self.base}.bell", create=True)
 
     # -- advertisement -------------------------------------------------------
@@ -352,11 +357,15 @@ class ShmRpcServer:
                     )
         return n
 
-    def send(self, chan, reply, raw_buffers=True):
+    def send(self, chan, reply, raw_buffers=True, ding=True):
         """Write one reply to a channel and ding its bell.  False when
         the reply could not be delivered (full ring / dead channel) —
         the client's same-mid retry re-fetches it from the reply cache,
-        over whichever transport it lands on."""
+        over whichever transport it lands on.  ``ding=False`` defers
+        the wake to the caller's next :meth:`flush_bells` — the batched
+        multi-record doorbell a reply burst rides (the record is
+        committed and readable either way; only the wake is deferred,
+        so the flush MUST come before the sender blocks)."""
         try:
             frames = wire.encode(reply, raw_buffers=raw_buffers)
             ok = chan.writer.send_frames(frames, timeout_ms=SEND_TIMEOUT_MS)
@@ -389,9 +398,32 @@ class ShmRpcServer:
             if self.counters is not None and self.bytes_counter:
                 self.counters.incr(self.bytes_counter,
                                    frames_nbytes(frames))
-            if chan.bell is not None:
-                chan.bell.ding()
+            self._ding(chan, ding)
         return ok
+
+    def _ding(self, chan, now):
+        if chan.bell is None:
+            return
+        if now:
+            chan.bell.ding()
+        else:
+            self._deferred_bells.add(chan.name)
+
+    def flush_bells(self):
+        """Ring every bell deferred by ``send(..., ding=False)`` /
+        ``commit_send(..., ding=False)`` — one ding per channel however
+        many records the burst committed.  Dropped channels are skipped
+        (their client is gone; its retry re-dials)."""
+        if not self._deferred_bells:
+            return 0
+        n = 0
+        for name in self._deferred_bells:
+            chan = self._channels.get(name)
+            if chan is not None and chan.bell is not None:
+                chan.bell.ding()
+                n += 1
+        self._deferred_bells.clear()
+        return n
 
     def begin_send(self, chan, sizes):
         """Zero-copy reply: reserve one ring record shaped as a
@@ -426,15 +458,16 @@ class ShmRpcServer:
             self.counters.incr(self.bytes_counter, sum(sizes))
         return out
 
-    def commit_send(self, chan):
+    def commit_send(self, chan, ding=True):
         """Publish the record reserved by :meth:`begin_send` and wake
-        the client."""
+        the client (``ding=False`` defers the wake to
+        :meth:`flush_bells`, same contract as ``send``)."""
         chan.writer.commit_record()
-        if chan.bell is not None:
-            chan.bell.ding()
+        self._ding(chan, ding)
 
     def _drop(self, chan):
         self._channels.pop(chan.name, None)
+        self._deferred_bells.discard(chan.name)
         try:
             chan.reader.close(unlink=True)
         except Exception:  # noqa: BLE001 - teardown best-effort

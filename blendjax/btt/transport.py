@@ -322,6 +322,22 @@ class RpcChannel:
         self._backoff_s = 1.0
         self._alive = False
 
+    def redirect(self, address):
+        """Re-point the channel at a NEW peer (the sharded gateway's
+        worker handoff: the front's reset reply names the worker that
+        owns the lease, and steady-state traffic dials it directly).
+        Both transports drop via :meth:`reset`; unlike a plain reset, a
+        permanent shm refusal is also cleared — it belonged to the OLD
+        peer (a pure-ZMQ front refuses, the worker it hands off to
+        accepts)."""
+        if address == self.address:
+            return
+        self.reset()
+        if self._state == "off":
+            self._state = "idle"
+        self._rpcs = 0
+        self.address = address
+
     def close(self):
         self.reset()
 

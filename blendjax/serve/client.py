@@ -48,8 +48,21 @@ class ServeClient:
 
     def __init__(self, address, *, fault_policy=None, counters=None,
                  timeoutms=5000, context=None, span_recorder=None,
-                 name="serve", model=None, shm="auto", shm_chaos=None):
+                 name="serve", model=None, shm="auto", shm_chaos=None,
+                 follow_redirects=True):
         self.address = address
+        #: the address this client was CONSTRUCTED with — against a
+        #: sharded gateway that is the front, and the recovery anchor:
+        #: when a direct-dialed worker dies, the client falls back here
+        #: so the next RPC re-resolves (see rpc())
+        self._front_address = address
+        #: follow a sharded front's ``gw_workers`` handoff (reset
+        #: replies name the worker owning the new lease; the client
+        #: re-points its channel at that worker's own address so
+        #: steady-state traffic never crosses the front again).
+        #: ``False`` pins every request to the constructed address —
+        #: chaos proxies and probes that must see one fixed peer.
+        self.follow_redirects = bool(follow_redirects)
         self.name = name
         self.policy = fault_policy or FaultPolicy()
         self.state = self.policy.new_state()
@@ -65,6 +78,10 @@ class ServeClient:
         #: ServeRPCError text and span args so a misbehaving replica is
         #: diagnosable from a client traceback alone
         self.replica = None
+        #: the gateway WORKER that served the last reply (stamped in
+        #: worker mode; None against a bare server or a plain gateway)
+        #: — the sharded analog of the replica stamp
+        self.gw_worker = None
         #: the WeightBus version that served the LAST reply (stamped by
         #: subscribed servers; None against a bus-less server) —
         #: surfaced alongside the replica stamp, so a bad-version
@@ -119,37 +136,78 @@ class ServeClient:
         # traceback names the suspect replica AND the suspect version
         via = (f", last replica {self.replica}"
                if self.replica is not None else "")
+        if self.gw_worker is not None:
+            via += f", gateway worker {self.gw_worker}"
         if self.weight_version is not None:
             via += f", weights v{self.weight_version}"
         span_args = {}
         if self.replica is not None:
             span_args["replica"] = self.replica
+        if self.gw_worker is not None:
+            span_args["gw_worker"] = self.gw_worker
         if self.weight_version is not None:
             span_args["weight_version"] = self.weight_version
-        reply = exactly_once_rpc(
-            self._channel, msg,
-            policy=self.policy, state=self.state,
-            counters=self.counters,
-            wait_ms=(self.timeoutms if timeout_ms is None
-                     else int(timeout_ms)),
-            raw_buffers=raw_buffers, spans=self.spans,
-            remote_name="policy server",
-            span_label="serve_rpc", span_cat="serve_client",
-            span_args=span_args or None,
-            rpc_name=f"{self.name}:{cmd}",
-            exc_factory=lambda text: ServeRPCError(
-                f"policy server ({self.address}{via}): {text}"
-            ),
-            retryable=(ServeRPCError,),
-            pop_mid=True,
-        )
+        try:
+            reply = exactly_once_rpc(
+                self._channel, msg,
+                policy=self.policy, state=self.state,
+                counters=self.counters,
+                wait_ms=(self.timeoutms if timeout_ms is None
+                         else int(timeout_ms)),
+                raw_buffers=raw_buffers, spans=self.spans,
+                remote_name="policy server",
+                span_label="serve_rpc", span_cat="serve_client",
+                span_args=span_args or None,
+                rpc_name=f"{self.name}:{cmd}",
+                exc_factory=lambda text: ServeRPCError(
+                    f"policy server ({self.address}{via}): {text}"
+                ),
+                retryable=(ServeRPCError,),
+                pop_mid=True,
+            )
+        except ServeRPCError:
+            if self.follow_redirects and self.address != self._front_address:
+                # the direct-dialed gateway worker went silent: fall
+                # back to the front so the NEXT rpc re-resolves (the
+                # front answers, relays to a live worker, or names the
+                # stale lease) — the raised error already carries the
+                # dead worker's id in its text
+                logger.warning(
+                    "%s: gateway worker %s at %s unresponsive; falling "
+                    "back to the front at %s", self.name, self.gw_worker,
+                    self.address, self._front_address,
+                )
+                self._channel().redirect(self._front_address)
+                self.address = self._front_address
+            raise
         rep = reply.get("replica")
         if rep is not None:
             self.replica = rep
+        gw = reply.get("gw_worker")
+        if gw is not None:
+            self.gw_worker = gw
         wv = reply.get("weight_version")
         if wv is not None:
             self.weight_version = wv
+        self._maybe_follow(reply)
         return reply
+
+    def _maybe_follow(self, reply):
+        """A sharded front's handoff: a reply naming both the worker
+        that answered (``gw_worker``) and the live worker address map
+        (``gw_workers``) moves this client's channel onto that worker's
+        own address — steady-state traffic skips the front entirely."""
+        if not self.follow_redirects:
+            return
+        gwmap = reply.get("gw_workers")
+        tag = reply.get("gw_worker")
+        if not isinstance(gwmap, dict) or tag is None:
+            return
+        target = gwmap.get(tag)
+        if target is None or target == self.address:
+            return
+        self._channel().redirect(target)
+        self.address = target
 
     # -- episode protocol ----------------------------------------------------
 

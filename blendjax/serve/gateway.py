@@ -74,8 +74,11 @@ import argparse
 import logging
 import os
 import signal
+import subprocess
+import sys
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 
 from blendjax import wire
@@ -97,10 +100,12 @@ logger = logging.getLogger("blendjax")
 ROUTE_CACHE_DEPTH = 8192
 
 #: Commands the gateway answers itself (never forwarded): aggregate
-#: capability/stats/telemetry, the drain lifecycle, and the weight-bus
-#: canary lifecycle (docs/weight_bus.md).
+#: capability/stats/telemetry, the drain lifecycle, the weight-bus
+#: canary lifecycle (docs/weight_bus.md), and the sharded control
+#: plane's versioned routing-state publication (``gw_snapshot``,
+#: worker mode only — see :class:`ShardedGateway`).
 GATEWAY_CMDS = ("hello", "stats", "telemetry", "drain", "undrain",
-                "canary", "promote", "rollback")
+                "canary", "promote", "rollback", "gw_snapshot")
 
 #: Per-weight-version reply metrics kept (newest versions win): enough
 #: for a canary + stable + a few predecessors, bounded regardless of
@@ -248,11 +253,34 @@ class ServeGateway:
                  quarantine_after_s=None, lease_ttl_s=600.0,
                  counters=None, timer=None,
                  reply_cache_depth=REPLY_CACHE_DEPTH, context=None,
-                 shm_base=None):
+                 shm_base=None, worker_index=None, n_workers=1,
+                 enable_shm=True):
         import zmq
 
         if not replicas:
             raise ValueError("a gateway needs >= 1 replica address")
+        #: sharded-data-plane worker identity (None = a standalone
+        #: gateway).  A worker gateway does NOT scrape or quarantine
+        #: replicas itself — replica health / drain / load / canary
+        #: state arrives as versioned ``gw_snapshot`` publications from
+        #: the control plane (the WeightBus publish pattern pointed at
+        #: routing state), so nothing on the request path ever RPCs the
+        #: control plane.  Its lease ids are congruent to
+        #: ``worker_index`` mod ``n_workers``, so any party can compute
+        #: a lease's owning worker with zero shared state.
+        self.worker_index = None if worker_index is None \
+            else int(worker_index)
+        self.n_workers = int(n_workers)
+        self.worker_tag = (None if self.worker_index is None
+                           else f"gw{self.worker_index}")
+        #: last applied control-snapshot version (worker mode; stale
+        #: versions are ignored so re-ordered publishes cannot roll
+        #: routing state backwards)
+        self._snap_version = -1
+        #: per-replica incarnation as published by the control plane —
+        #: a bump means the control saw a death/restart this worker may
+        #: have missed, so local leases on it must die
+        self._snap_inc = {}
         self.scrape_interval_s = float(scrape_interval_s)
         self.quarantine_after_s = (
             max(1.0, 4 * self.scrape_interval_s)
@@ -287,7 +315,11 @@ class ServeGateway:
         self._scrapes = {}             # mid -> replica id
         self._leases = {}              # gw episode id -> _Lease
         self._lease_rev = {}           # (rid, incarnation, real ep) -> gw ep
-        self._ep_seq = 0
+        #: lease-id sequence.  Standalone: 0, 1, 2, ...  Worker k of N:
+        #: k+N, k+2N, ... — every id ≡ k (mod N), never below N (0 is
+        #: not a valid lease and ids < N would alias worker indices)
+        self._ep_seq = (0 if self.worker_index is None
+                        else self.worker_index)
         self._reply_cache = OrderedDict()
         self._reply_cache_depth = int(reply_cache_depth)
         #: watchdog notices (thread-safe appends), applied on the loop
@@ -297,7 +329,7 @@ class ServeGateway:
         #: reply-wake fd for the BACKEND shm channels, so one poller
         #: entry covers every ring this process reads
         self._shm_front = None
-        if shm_rpc.enabled():
+        if enable_shm and shm_rpc.enabled():
             self._shm_front = shm_rpc.ShmRpcServer(
                 base=shm_base or shm_rpc.new_base("gw"),
                 counters=self.counters, who="gateway",
@@ -575,6 +607,12 @@ class ServeGateway:
         import zmq
 
         now = time.monotonic()
+        if self.worker_index is not None:
+            # worker mode: the control plane owns scrapes, quarantine
+            # verdicts and re-admission (published via gw_snapshot) —
+            # only the local lease-TTL sweep below runs here
+            self._lease_sweep(now)
+            return
         for rep in self._replicas.values():
             if rep.scrape_mid is not None and \
                     now - rep.scrape_sent > self.scrape_interval_s * 2:
@@ -599,11 +637,14 @@ class ServeGateway:
                 self._scrapes[mid] = rep.id
             if rep.healthy and now - rep.last_ok > self.quarantine_after_s:
                 self._quarantine(rep)
+        self._lease_sweep(now)
+
+    def _lease_sweep(self, now):
+        """Abandoned-episode sweep: a client that crashed without
+        ``close()`` must not leak a lease forever (the replica reclaims
+        the slot via ``slot_ttl_s``; this is the gateway's analogue).
+        Swept on the scrape cadence, amortized."""
         if self.lease_ttl_s is not None and now >= self._next_lease_sweep:
-            # abandoned-episode sweep: a client that crashed without
-            # close() must not leak a lease forever (the replica
-            # reclaims the slot via slot_ttl_s; this is the gateway's
-            # analogue).  Swept on the scrape cadence, amortized.
             self._next_lease_sweep = now + max(1.0, self.lease_ttl_s / 4)
             cutoff = now - self.lease_ttl_s
             for gw_ep in [ep for ep, lease in self._leases.items()
@@ -723,6 +764,69 @@ class ServeGateway:
         logger.info("gateway: replica %s upgraded to shm channel %s",
                     rep.id, chan.name)
 
+    # -- control-snapshot subscription (worker mode) -------------------------
+
+    def _cmd_gw_snapshot(self, msg):
+        """Adopt one versioned control-plane snapshot: replica health /
+        drain / load / caps and the canary window, as scraped and
+        decided by the :class:`ShardedGateway` control thread.  Workers
+        only ever READ this consistent view — the request path never
+        RPCs the control plane.  Stale versions are ignored (re-ordered
+        publishes must not roll routing state backwards)."""
+        if self.worker_index is None:
+            return {"error": "gw_snapshot against a non-worker gateway"}
+        version = int(msg.get("version", -1))
+        if version <= self._snap_version:
+            return {"applied": False, "version": self._snap_version}
+        self._snap_version = version
+        for rid, snap in (msg.get("replicas") or {}).items():
+            rep = self._replicas.get(rid)
+            if not isinstance(snap, dict) or rep is None:
+                continue
+            inc = int(snap.get("incarnation", 0))
+            known = self._snap_inc.get(rid)
+            if known is not None and inc > known:
+                # the control plane saw a death/restart (possibly a
+                # silent one) this worker may have missed: local leases
+                # on the replica must die before the new incarnation's
+                # recycled (slot, episode) pairs can alias them
+                self._quarantine(rep)
+            self._snap_inc[rid] = inc
+            if not snap.get("healthy", False):
+                self._quarantine(rep)
+            elif not rep.healthy:
+                rep.healthy = True
+                self.counters.incr("gateway_replica_respawns")
+            rep.draining = bool(snap.get("draining", False))
+            models = snap.get("models")
+            if models:
+                rep.models = set(models)
+            rep.queued = int(snap.get("queued", 0))
+            rep.live = int(snap.get("live", 0))
+            rep.pending_live = 0  # the snapshot's live count subsumes it
+            rep.p99_ms = float(snap.get("p99_ms") or 0.0)
+            rep.pid = snap.get("pid")
+            rep.weight_version = snap.get("weight_version")
+            caps = snap.get("caps")
+            if isinstance(caps, dict):
+                rep.caps = caps
+            if rep.healthy:
+                # the control plane vouches for the replica (its scrape
+                # answered): probe the shm upgrade off the snapshot
+                # cadence, exactly where the standalone gateway probes
+                # off its own scrape ingest
+                rep.last_ok = time.monotonic()
+                self._maybe_upgrade_backend(rep)
+        weights = msg.get("weights") or {}
+        self._canary_version = weights.get("canary_version")
+        self._canary_fraction = float(
+            weights.get("canary_fraction") or 0.0
+        )
+        self._stable_version = weights.get("stable_version")
+        self._rejected_version = weights.get("rejected_version")
+        self.counters.incr("gateway_snapshot_applies")
+        return {"applied": True, "version": version}
+
     # -- gateway-level commands ----------------------------------------------
 
     def _cmd_hello(self, msg):
@@ -748,6 +852,9 @@ class ServeGateway:
                     if self._shm_front is not None else None),
             "pid": os.getpid(),
         })
+        if self.worker_tag is not None:
+            out["gw_worker"] = self.worker_tag
+            out["n_workers"] = self.n_workers
         return out
 
     def _cmd_stats(self, msg):
@@ -971,6 +1078,10 @@ class ServeGateway:
         mid = msg.get(wire.BTMID_KEY)
         if "error" in reply:
             self.counters.incr("gateway_errors")
+        if self.worker_tag is not None and "gw_worker" not in reply:
+            # every worker-answered reply names its worker, so a wedged
+            # worker is diagnosable from a client traceback alone
+            reply["gw_worker"] = self.worker_tag
         span_ctx = msg.get(wire.SPAN_KEY)
         if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
             reply = dict(reply)
@@ -1175,6 +1286,8 @@ class ServeGateway:
             return
         del self._routes[mid]
         reply["replica"] = rep.id
+        if self.worker_tag is not None:
+            reply["gw_worker"] = self.worker_tag
         wv = reply.get("weight_version")
         if wv is not None:
             # per-version rollout metrics: every stamped reply lands in
@@ -1217,7 +1330,12 @@ class ServeGateway:
             key = (rep.id, rep.incarnation, real_ep)
             gw_ep = self._lease_rev.get(key)
             if gw_ep is None:
-                self._ep_seq += 1
+                # worker mode strides by the worker count, keeping
+                # every lease id ≡ worker_index (mod n_workers) — the
+                # consistent-hash ownership rule the sharded front and
+                # every client can evaluate statelessly
+                self._ep_seq += (1 if self.worker_index is None
+                                 else self.n_workers)
                 gw_ep = self._ep_seq
                 self._leases[gw_ep] = _Lease(
                     rep.id, reply.get("slot"), real_ep, route.model,
@@ -1389,6 +1507,835 @@ def start_gateway_thread(replicas, *, address="tcp://127.0.0.1:*",
     )
     thread.start()
     return _LocalGatewayHandle(gateway, thread, stop)
+
+
+# ---------------------------------------------------------------------------
+# Sharded data plane: N worker processes behind one front address
+# ---------------------------------------------------------------------------
+
+
+#: How many recent control-snapshot mids the front remembers: worker
+#: acks for those mids are swallowed instead of treated as client
+#: replies.  A handful of versions can be in flight across N workers;
+#: 64 is headroom.
+SNAPSHOT_MID_DEPTH = 64
+
+
+class _FrontRoute:
+    """One relayed, in-flight request at the sharded front: which
+    client to answer and which worker owes the reply."""
+
+    __slots__ = ("ident", "widx", "cmd")
+
+    def __init__(self, ident, widx, cmd):
+        self.ident = ident
+        self.widx = widx
+        self.cmd = cmd
+
+
+class _Worker:
+    """The front's view of one gateway worker process."""
+
+    __slots__ = ("idx", "tag", "address", "sock", "alive", "last_ok",
+                 "scrape_mid", "scrape_sent", "next_scrape", "counters")
+
+    def __init__(self, idx, address, sock, now):
+        self.idx = idx
+        self.tag = f"gw{idx}"
+        self.address = address
+        self.sock = sock
+        self.alive = True
+        self.last_ok = now
+        self.scrape_mid = None
+        self.scrape_sent = 0.0
+        self.next_scrape = 0.0
+        self.counters = {}
+
+
+class _GatewayLaunchInfo:
+    """The :class:`~blendjax.btt.watchdog.FleetWatchdog` launcher
+    contract (``.processes`` + owner's ``respawn``) for the worker
+    fleet."""
+
+    def __init__(self, processes, addresses):
+        self.processes = processes
+        self.addresses = {"GATEWAY_WORKER": addresses}
+
+
+class ShardedGateway:
+    """One client-facing front address over N ``GatewayWorker``
+    processes plus the control plane, in one supervising process.
+
+    The split (docs/serving.md, "The sharded gateway"):
+
+    - **data plane**: N worker processes (``python -m
+      blendjax.serve.gateway_worker``), each a full :class:`ServeGateway`
+      in worker mode with its own client-facing address, its own shm
+      front, its own leases and reply cache.  Lease ownership is
+      partitioned by the lease id itself — worker k allocates ids
+      ≡ k (mod N), so ``owner(ep) = ep % N`` is computable statelessly
+      by the front, a client, or a debugger;
+    - **front** (this class): binds the ONE address clients dial first.
+      It relays a client's first traffic to the owning worker, and every
+      successful ``reset`` reply gains a ``gw_workers`` map so the
+      client re-dials its owning worker DIRECTLY — steady-state request
+      bytes never cross the front again.  Fresh traffic (``reset``,
+      unroutable mids) is assigned by ``crc32(mid) % active_workers``
+      with a linear probe past dead workers, so a same-mid retry lands
+      on the worker whose dedupe/reply cache keeps it exactly-once;
+    - **control plane**: an inner :class:`ServeGateway` pointed at the
+      replica fleet, pumped from the front's loop.  It alone scrapes
+      telemetry, quarantines/re-admits replicas, owns drain flags and
+      canary/promote/rollback verdicts and the load-score table.  That
+      state reaches workers as a versioned ``gw_snapshot`` publication
+      (the WeightBus publish pattern pointed at routing state): workers
+      only ever READ a consistent snapshot and never RPC the control
+      plane on the request path.
+
+    Workers are supervised by a
+    :class:`~blendjax.btt.watchdog.FleetWatchdog` (``restart=True``).
+    A SIGKILLed worker takes its leases with it: the front answers
+    steps against its partition with the actionable stale-lease error
+    (``gateway_lease_rehash``) until the respawn's first answered
+    scrape re-admits it (``gateway_worker_respawns``), and clients
+    resume after ``reset()`` exactly as for a replica death.  Each
+    worker's ``/dev/shm`` segments live under a parent-pinned base
+    prefix that is glob-swept before its respawn and at close
+    (PR-12 hygiene).
+    """
+
+    def __init__(self, address, replicas, *, workers=2,
+                 scrape_interval_s=0.25, quarantine_after_s=None,
+                 lease_ttl_s=600.0, counters=None, timer=None,
+                 context=None, python=None, ready_timeout_s=60.0):
+        import zmq
+
+        from blendjax.replay.shard_client import free_port
+
+        if int(workers) < 1:
+            raise ValueError("a sharded gateway needs >= 1 worker")
+        self.n_workers = int(workers)
+        #: fresh-traffic hash window (bench arms shrink it; lease-owned
+        #: traffic still reaches workers outside the window)
+        self.active_workers = self.n_workers
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.quarantine_after_s = (
+            max(1.0, 4 * self.scrape_interval_s)
+            if quarantine_after_s is None else float(quarantine_after_s)
+        )
+        self.counters = counters if counters is not None else fleet_counters
+        self.timer = timer if timer is not None else StageTimer()
+        self._ctx = context or zmq.Context.instance()
+        self._front = self._ctx.socket(zmq.ROUTER)
+        self._front.setsockopt(zmq.LINGER, 0)
+        if address.endswith(":*") or address.endswith(":0"):
+            base = address.rsplit(":", 1)[0]
+            port = self._front.bind_to_random_port(base)
+            self.address = f"{base}:{port}"
+        else:
+            self._front.bind(address)
+            self.address = address
+        #: the control plane: a standalone ServeGateway over the replica
+        #: fleet, pumped from THIS loop.  Its client front is an unused
+        #: ephemeral port; what we want is its scrape/quarantine/canary
+        #: machinery and its replica table — the gw_snapshot source.
+        self._ctl = ServeGateway(
+            "tcp://127.0.0.1:*", replicas,
+            scrape_interval_s=self.scrape_interval_s,
+            quarantine_after_s=quarantine_after_s,
+            lease_ttl_s=None, counters=self.counters, timer=self.timer,
+            context=self._ctx, enable_shm=False,
+        )
+        self.python = python or sys.executable
+        self.ready_timeout_s = float(ready_timeout_s)
+        now = time.monotonic()
+        self._workers = []
+        self._wcmds = []
+        #: parent-pinned shm base prefix per worker: respawns reuse the
+        #: name, and the parent glob-sweeps it before each respawn and
+        #: at close, so a SIGKILLed worker cannot leak /dev/shm
+        self._wbases = []
+        for k in range(self.n_workers):
+            waddr = f"tcp://127.0.0.1:{free_port()}"
+            base = (shm_rpc.new_base(f"gww{k}")
+                    if shm_rpc.enabled() else None)
+            cmd = [self.python, "-m", "blendjax.serve.gateway_worker",
+                   "--address", waddr,
+                   "--worker-index", str(k),
+                   "--workers", str(self.n_workers),
+                   "--scrape-interval", str(self.scrape_interval_s)]
+            if lease_ttl_s is not None:
+                cmd += ["--lease-ttl", str(float(lease_ttl_s))]
+            for addr in replicas:
+                cmd += ["--replica", addr]
+            if base is not None:
+                cmd += ["--shm-base", base]
+            sock = self._ctx.socket(zmq.DEALER)
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.connect(waddr)
+            self._workers.append(_Worker(k, waddr, sock, now))
+            self._wcmds.append(cmd)
+            self._wbases.append(base)
+        self._routes = OrderedDict()   # mid -> _FrontRoute
+        self._wscrapes = {}            # mid -> worker idx
+        self._snap_mids = deque(maxlen=SNAPSHOT_MID_DEPTH)
+        self._snap_version = -1
+        self._next_publish = 0.0
+        self._notices = deque()
+        self.launch_info = None
+
+    # -- worker process management -------------------------------------------
+
+    def _spawn(self, idx):
+        from blendjax.btt.launcher import child_env
+
+        env = child_env()
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        return subprocess.Popen(self._wcmds[idx], env=env,
+                                start_new_session=True)
+
+    def start(self):
+        procs = []
+        try:
+            for k in range(self.n_workers):
+                procs.append(self._spawn(k))
+            self.launch_info = _GatewayLaunchInfo(
+                procs, [w.address for w in self._workers])
+            self._wait_ready()
+        except BaseException:
+            if self.launch_info is None:
+                self.launch_info = _GatewayLaunchInfo(procs, [])
+            self.close()
+            raise
+        return self
+
+    def _wait_ready(self):
+        from blendjax.serve.client import ServeClient
+
+        deadline = time.monotonic() + self.ready_timeout_s
+        for w in self._workers:
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"gateway worker {w.tag} at {w.address} not "
+                        f"ready within {self.ready_timeout_s:.1f}s"
+                    )
+                probe = ServeClient(w.address, timeoutms=500, shm=False,
+                                    follow_redirects=False)
+                try:
+                    probe.hello()
+                    break
+                except TimeoutError:
+                    continue
+                finally:
+                    probe.close()
+
+    def respawn(self, idx):
+        """FleetWatchdog's restart hook: sweep the dead worker's shm
+        base first (PR-12 hygiene), then relaunch the SAME command —
+        address, index and base prefix are parent-pinned, so the
+        respawn rejoins under its old identity."""
+        if self._wbases[idx] is not None:
+            shm_rpc.unlink_base(self._wbases[idx])
+        proc = self._spawn(idx)
+        self.launch_info.processes[idx] = proc
+        return proc
+
+    # -- admin (thread-safe flag sets on the control plane; workers
+    # -- learn of them from the next published snapshot) ---------------------
+
+    def drain(self, rid):
+        return self._ctl.drain(rid)
+
+    def undrain(self, rid):
+        return self._ctl.undrain(rid)
+
+    def canary(self, version, fraction=0.25):
+        return self._ctl.canary(version, fraction)
+
+    def promote(self):
+        return self._ctl.promote()
+
+    def rollback(self):
+        return self._ctl.rollback()
+
+    # -- watchdog notices (thread-safe; applied on the loop) -----------------
+
+    def notify_worker_death(self, idx, exit_code=None):
+        self._notices.append(("death", int(idx)))
+
+    def notify_worker_respawn(self, idx, proc=None):
+        self._notices.append(("respawn", int(idx)))
+
+    def notify_replica_death(self, idx_or_rid, exit_code=None):
+        self._ctl.notify_replica_death(idx_or_rid, exit_code)
+
+    def notify_replica_respawn(self, idx_or_rid, proc=None):
+        self._ctl.notify_replica_respawn(idx_or_rid, proc)
+
+    def _apply_notices(self):
+        while self._notices:
+            kind, idx = self._notices.popleft()
+            w = self._workers[idx]
+            if kind == "death":
+                self._mark_worker_dead(w)
+            else:
+                # probe the respawn immediately: its first answered
+                # scrape re-admits it
+                w.next_scrape = 0.0
+
+    def _mark_worker_dead(self, w):
+        if not w.alive:
+            return
+        w.alive = False
+        if w.scrape_mid is not None:
+            self._wscrapes.pop(w.scrape_mid, None)
+            w.scrape_mid = None
+        self.counters.incr("gateway_worker_deaths")
+        logger.warning(
+            "gateway front: worker %s at %s is gone — its lease "
+            "partition (ep %% %d == %d) is stale until respawn",
+            w.tag, w.address, self.n_workers, w.idx,
+        )
+
+    def set_active_workers(self, n):
+        """Restrict FRESH-traffic hash assignment (and the
+        ``gw_workers`` redirect map) to the first ``n`` workers.  A
+        bench knob: the 1-worker and N-worker arms run over the same
+        fleet and the same worker processes.  Lease-owned traffic
+        still reaches its owning worker.
+
+        ``n == 1`` collapses the data plane to the UNSHARDED shape:
+        the front withholds the direct-dial map, so every message —
+        fresh and lease-owned alike — rides this one front address
+        through one event loop, exactly what a monolithic gateway
+        deployment looks like to clients.  That is the baseline arm
+        of ``gateway_shard_x``; ``n > 1`` restores partitioned
+        direct dial."""
+        self.active_workers = max(1, min(int(n), self.n_workers))
+        return self.active_workers
+
+    # -- worker health + control snapshots -----------------------------------
+
+    def _worker_tick(self):
+        import zmq
+
+        now = time.monotonic()
+        for w in self._workers:
+            if (w.scrape_mid is not None
+                    and now - w.scrape_sent > 2 * self.scrape_interval_s):
+                self._wscrapes.pop(w.scrape_mid, None)
+                w.scrape_mid = None
+            if w.scrape_mid is None and now >= w.next_scrape:
+                msg = {"cmd": "telemetry"}
+                mid = wire.stamp_message_id(msg)
+                try:
+                    wire.send_message_dealer(w.sock, msg,
+                                             flags=zmq.DONTWAIT)
+                except zmq.ZMQError:
+                    continue
+                w.scrape_mid = mid
+                w.scrape_sent = now
+                w.next_scrape = now + self.scrape_interval_s
+                self._wscrapes[mid] = w.idx
+            if w.alive and now - w.last_ok > self.quarantine_after_s:
+                self._mark_worker_dead(w)
+
+    def _ingest_worker_scrape(self, w, reply):
+        w.scrape_mid = None
+        if not w.alive:
+            w.alive = True
+            self.counters.incr("gateway_worker_respawns")
+            logger.warning(
+                "gateway front: worker %s answered again — re-admitted",
+                w.tag,
+            )
+            # a fresh worker starts with an empty routing view: publish
+            # the current control state before client traffic reaches it
+            self._publish_snapshot(force=True)
+        counters = reply.get("counters")
+        if isinstance(counters, dict):
+            w.counters = counters
+
+    def _publish_snapshot(self, force=False):
+        """Version and fan the control plane's routing state out to the
+        workers (replica health/drain/load + canary verdicts).  Workers
+        apply it atomically under their GIL; stale versions are
+        ignored, so a re-ordered publish can never roll a worker's view
+        backwards."""
+        import zmq
+
+        now = time.monotonic()
+        if not force and now < self._next_publish:
+            return
+        self._next_publish = now + self.scrape_interval_s
+        ctl = self._ctl
+        self._snap_version += 1
+        msg = {
+            "cmd": "gw_snapshot",
+            "version": self._snap_version,
+            "replicas": {
+                rep.id: {
+                    "healthy": rep.healthy,
+                    "draining": rep.draining,
+                    "models": sorted(rep.models or ()),
+                    "queued": rep.queued,
+                    "live": rep.live,
+                    "p99_ms": rep.p99_ms,
+                    "pid": rep.pid,
+                    "incarnation": rep.incarnation,
+                    "weight_version": rep.weight_version,
+                    "caps": rep.caps,
+                }
+                for rep in ctl._replicas.values()
+            },
+            "weights": {
+                "canary_version": ctl._canary_version,
+                "canary_fraction": ctl._canary_fraction,
+                "stable_version": ctl._stable_version,
+                "rejected_version": ctl._rejected_version,
+            },
+        }
+        mid = wire.stamp_message_id(msg)
+        self._snap_mids.append(mid)
+        sent = 0
+        for w in self._workers:
+            if not w.alive:
+                continue
+            try:
+                wire.send_message_dealer(w.sock, msg, flags=zmq.DONTWAIT)
+                sent += 1
+            except zmq.ZMQError:
+                continue
+        if sent:
+            self.counters.incr("gateway_snapshot_publishes")
+
+    # -- front request handling ----------------------------------------------
+
+    def _worker_map(self):
+        """tag -> direct-dial address for the live workers in the
+        active window — what a successful ``reset`` reply carries so
+        the client's steady-state traffic skips this front."""
+        return {w.tag: w.address
+                for w in self._workers[:self.active_workers] if w.alive}
+
+    def _sharded_fields(self):
+        return {
+            "gateway": True,
+            "sharded": True,
+            "workers": self.n_workers,
+            "active_workers": self.active_workers,
+            "workers_alive": sum(1 for w in self._workers if w.alive),
+            "gw_workers": self._worker_map(),
+            "gw_n_workers": self.n_workers,
+            "pid": os.getpid(),
+        }
+
+    def gateway_counters(self):
+        """``gateway_*`` counters merged across the front process and
+        every worker's latest scrape — the fleet-wide view ``stats``
+        and ``telemetry`` answer with."""
+        out = dict(self.counters.snapshot())
+        for w in self._workers:
+            for key, val in (w.counters or {}).items():
+                if key.startswith("gateway_") or key == "stale_replies":
+                    out[key] = out.get(key, 0) + val
+        return out
+
+    def _pick_worker_for_mid(self, mid):
+        """Deterministic fresh-traffic assignment: crc32 of the
+        correlation id over the active window (NOT ``hash()`` — that is
+        salted per process), linear-probed past dead workers so a
+        same-mid retry lands on the same worker whenever that worker is
+        up (its dedupe/reply cache keeps the retry exactly-once)."""
+        n = max(1, min(self.active_workers, len(self._workers)))
+        start = zlib.crc32(str(mid).encode()) % n
+        for k in range(n):
+            w = self._workers[(start + k) % n]
+            if w.alive:
+                return w
+        return None
+
+    def _front_reply(self, ident, msg, reply, *, span_name):
+        """Answer a request from the front itself.  No reply cache:
+        every front-local answer is a pure function of (request,
+        current worker liveness), so a same-mid retry recomputes the
+        same answer."""
+        import zmq
+
+        mid = msg.get(wire.BTMID_KEY)
+        if "error" in reply:
+            self.counters.incr("gateway_errors")
+        span_ctx = msg.get(wire.SPAN_KEY)
+        if isinstance(span_ctx, dict) and span_ctx.get("trace") is not None:
+            reply = dict(reply)
+            reply[wire.SPANS_KEY] = [make_span(
+                span_name, now_us(), trace=span_ctx["trace"],
+                cat="gateway",
+            )]
+        if mid is not None:
+            reply[wire.BTMID_KEY] = mid
+        try:
+            wire.send_message_router(self._front, ident, reply,
+                                     raw_buffers=True)
+            self.counters.incr("gateway_replies")
+        except zmq.ZMQError:
+            pass  # client gone; its retry will re-dial
+
+    def _resolve(self, msg):
+        """``gw_resolve``: map an episode lease to its owning worker.
+        The recovery path for a client that direct-dialed a worker that
+        died — it asks the front where to go next."""
+        ep = msg.get("episode")
+        try:
+            widx = int(ep) % self.n_workers
+        except (TypeError, ValueError):
+            return {"error": (
+                f"gw_resolve needs an integer episode lease, got {ep!r}"
+            ), "gw_workers": self._worker_map()}
+        w = self._workers[widx]
+        return {"gw_worker": w.tag, "address": w.address,
+                "alive": w.alive, "gw_workers": self._worker_map()}
+
+    def _handle_front_client(self, ident, msg):
+        import zmq
+
+        mid = msg.get(wire.BTMID_KEY)
+        cmd = msg.get("cmd")
+        # the front is pure ZMQ: shm negotiation gets the standard
+        # refusal (clients mark the channel off and, after redirecting
+        # to their worker's address, re-arm and negotiate THERE)
+        reply = shm_rpc.control_reply(None, msg)
+        if reply is not None:
+            try:
+                wire.send_message_router(self._front, ident, reply)
+            except zmq.ZMQError:
+                pass
+            return
+        if cmd == "gw_resolve":
+            self.counters.incr("gateway_requests")
+            self._front_reply(ident, msg, self._resolve(msg),
+                              span_name="gateway:gw_resolve")
+            return
+        if cmd == "hello":
+            self.counters.incr("gateway_requests")
+            out = self._ctl._cmd_hello(msg)
+            if "obs_dim" not in out and mid is not None:
+                # the control plane has not scraped capabilities yet
+                # (startup): relay through a worker, which forwards to
+                # a replica; the reply path overlays the sharded fields
+                w = self._pick_worker_for_mid(mid)
+                if w is not None:
+                    self._relay_to(w, ident, msg, cmd)
+                    return
+            out.update(self._sharded_fields())
+            self._front_reply(ident, msg, out, span_name="gateway:hello")
+            return
+        if cmd in ("drain", "undrain", "canary", "promote", "rollback"):
+            self.counters.incr("gateway_requests")
+            handler = getattr(self._ctl, f"_cmd_{cmd}")
+            try:
+                out = handler(msg)
+            except Exception as exc:  # noqa: BLE001 - report, don't die
+                logger.exception("gateway front: %s failed", cmd)
+                out = {"error": f"{type(exc).__name__}: {exc}"}
+            # admin verdicts must not wait a scrape interval to reach
+            # the data plane
+            self._publish_snapshot(force=True)
+            self._front_reply(ident, msg, out,
+                              span_name=f"gateway:{cmd}")
+            return
+        if cmd == "stats":
+            self.counters.incr("gateway_requests")
+            self._front_reply(ident, msg, self._cmd_stats(msg),
+                              span_name="gateway:stats")
+            return
+        if cmd == "telemetry":
+            self.counters.incr("gateway_requests")
+            self._front_reply(ident, msg, self._cmd_telemetry(msg),
+                              span_name="gateway:telemetry")
+            return
+        self._relay(ident, msg, cmd, mid)
+
+    def _cmd_stats(self, msg):
+        out = self._ctl._cmd_stats(msg)
+        out.update(self._sharded_fields())
+        out["counters"] = self.gateway_counters()
+        return out
+
+    def _cmd_telemetry(self, msg):
+        out = self._ctl._cmd_telemetry(msg)
+        out.update(self._sharded_fields())
+        out["counters"] = self.gateway_counters()
+        return out
+
+    def _relay(self, ident, msg, cmd, mid):
+        if mid is None:
+            self.counters.incr("gateway_requests")
+            self._front_reply(ident, msg, {"error": (
+                f"{cmd!r} through a gateway needs a correlation id "
+                "(wire.stamp_message_id); its reply could not be "
+                "routed back otherwise"
+            )}, span_name=f"gateway:{cmd}")
+            return
+        route = self._routes.get(mid)
+        if route is not None:
+            # a retry of an in-flight relay: same worker (its dedupe /
+            # reply cache keeps it exactly-once) as long as it lives
+            w = self._workers[route.widx]
+            if w.alive:
+                route.ident = ident
+                self._relay_to(w, ident, msg, cmd, record=False)
+                return
+            del self._routes[mid]
+        if cmd in ("step", "close"):
+            ep = msg.get("episode")
+            widx = None
+            try:
+                widx = int(ep) % self.n_workers
+            except (TypeError, ValueError):
+                pass  # unintelligible lease: any live worker rejects it
+            if widx is not None:
+                w = self._workers[widx]
+                if not w.alive:
+                    # the owning worker died and took the lease's
+                    # reply cache / replica route with it — the lease
+                    # is unrecoverable, exactly like a replica death
+                    self.counters.incr("gateway_requests")
+                    self.counters.incr("gateway_lease_rehash")
+                    self.counters.incr("gateway_stale_lease_redirects")
+                    if cmd == "close":
+                        self._front_reply(ident, msg, {"closed": False},
+                                          span_name="gateway:close")
+                    else:
+                        self._front_reply(ident, msg, {"error": (
+                            f"stale episode lease {ep!r} (gateway "
+                            f"worker {w.tag} died): reset() and resume "
+                            "on a healthy replica"
+                        ), "lease": "stale"}, span_name="gateway:step")
+                    return
+                self._relay_to(w, ident, msg, cmd)
+                return
+        w = self._pick_worker_for_mid(mid)
+        if w is None:
+            self.counters.incr("gateway_requests")
+            self._front_reply(ident, msg, {"error": (
+                "no live gateway worker (of "
+                f"{[x.tag for x in self._workers]}): retry after the "
+                "watchdog respawns one"
+            )}, span_name=f"gateway:{cmd}")
+            return
+        self._relay_to(w, ident, msg, cmd)
+
+    def _relay_to(self, w, ident, msg, cmd, record=True):
+        import zmq
+
+        mid = msg.get(wire.BTMID_KEY)
+        if record and mid is not None:
+            self._routes[mid] = _FrontRoute(ident, w.idx, cmd)
+            while len(self._routes) > ROUTE_CACHE_DEPTH:
+                self._routes.popitem(last=False)
+        try:
+            wire.send_message_dealer(w.sock, msg, raw_buffers=True,
+                                     flags=zmq.DONTWAIT)
+        except zmq.Again:
+            if mid is not None:
+                self._routes.pop(mid, None)
+            self.counters.incr("gateway_requests")
+            self._front_reply(ident, msg, {"error": (
+                f"gateway worker {w.tag} send queue full (stalled or "
+                "unreachable): retry, or reset() after its respawn"
+            )}, span_name=f"gateway:{cmd}")
+            return
+        except zmq.ZMQError:
+            if mid is not None:
+                self._routes.pop(mid, None)
+            return
+        self.counters.incr("gateway_front_relays")
+
+    def _handle_worker_reply(self, w, reply):
+        w.last_ok = time.monotonic()
+        mid = reply.get(wire.BTMID_KEY)
+        if mid is not None and mid in self._wscrapes:
+            del self._wscrapes[mid]
+            self._ingest_worker_scrape(w, reply)
+            return
+        if mid is not None and mid in self._snap_mids:
+            return  # snapshot ack
+        route = self._routes.pop(mid, None) if mid is not None else None
+        if route is None:
+            self.counters.incr("stale_replies")
+            return
+        if (route.cmd == "reset" and "error" not in reply
+                and self.active_workers > 1):
+            # the redirect payload: the client moves its channel to its
+            # owning worker's own address and never relays here again.
+            # With the data plane collapsed to one worker the map is
+            # withheld — every message keeps riding this front, which
+            # IS the unsharded single-address shape the shard bench
+            # arm measures against.
+            reply["gw_workers"] = self._worker_map()
+            reply["gw_n_workers"] = self.n_workers
+        elif route.cmd == "hello":
+            fields = self._sharded_fields()
+            if self.active_workers == 1:
+                fields.pop("gw_workers", None)
+            reply.update(fields)
+        import zmq
+
+        try:
+            wire.send_message_router(self._front, route.ident, reply,
+                                     raw_buffers=True)
+            self.counters.incr("gateway_replies")
+        except zmq.ZMQError:
+            pass
+
+    # -- loop ----------------------------------------------------------------
+
+    def _drain_front(self):
+        import zmq
+
+        drain_socket(
+            lambda: wire.recv_message_router(self._front,
+                                             flags=zmq.NOBLOCK),
+            lambda out: self._handle_front_client(out[0], out[1]),
+            self.counters, "gateway front", "client request",
+        )
+
+    def _drain_worker(self, w):
+        import zmq
+
+        drain_socket(
+            lambda: wire.recv_message_dealer(w.sock, flags=zmq.NOBLOCK),
+            lambda reply: self._handle_worker_reply(w, reply),
+            self.counters, "gateway front", "worker reply",
+        )
+
+    def serve_forever(self, stop_event=None, poll_ms=50):
+        import zmq
+
+        poller = zmq.Poller()
+        poller.register(self._front, zmq.POLLIN)
+        for w in self._workers:
+            poller.register(w.sock, zmq.POLLIN)
+        for rep in self._ctl._replicas.values():
+            poller.register(rep.sock, zmq.POLLIN)
+        while stop_event is None or not stop_event.is_set():
+            self._apply_notices()
+            self._ctl._apply_notices()
+            self._ctl._scrape_tick()
+            self._worker_tick()
+            self._publish_snapshot()
+            try:
+                events = dict(poller.poll(poll_ms))
+                if self._front in events:
+                    self._drain_front()
+                for w in self._workers:
+                    if w.sock in events:
+                        self._drain_worker(w)
+                for rep in self._ctl._replicas.values():
+                    if rep.sock in events:
+                        self._ctl._drain_replica(rep)
+            except zmq.ZMQError:
+                return  # a socket closed under us: clean shutdown
+
+    def close(self):
+        try:
+            self._front.close(0)
+        except Exception:  # noqa: BLE001 - shutdown best-effort
+            pass
+        for w in self._workers:
+            try:
+                w.sock.close(0)
+            except Exception:  # noqa: BLE001
+                pass
+        info = self.launch_info
+        if info is not None:
+            for proc in info.processes:
+                try:
+                    proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+            for proc in info.processes:
+                try:
+                    proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    try:
+                        proc.kill()
+                        proc.wait(timeout=5)
+                    except Exception:  # noqa: BLE001
+                        pass
+        for base in self._wbases:
+            if base is not None:
+                shm_rpc.unlink_base(base)
+        self._ctl.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class _LocalShardedHandle:
+    """An in-process sharded-gateway front (thread) plus its worker
+    processes and watchdog, for tests and benchmarks."""
+
+    def __init__(self, gateway, thread, stop, watchdog):
+        self.gateway = gateway
+        self.address = gateway.address
+        self._thread = thread
+        self._stop = stop
+        self._watchdog = watchdog
+
+    def set_active_workers(self, n):
+        return self.gateway.set_active_workers(n)
+
+    def close(self):
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.gateway.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_sharded_gateway_thread(replicas, *, address="tcp://127.0.0.1:*",
+                                 workers=2, counters=None, timer=None,
+                                 supervise=True, watchdog_interval_s=0.2,
+                                 **kwargs):
+    """Spawn N gateway worker processes + the front/control loop in a
+    daemon thread, supervised by a FleetWatchdog (``restart=True``);
+    returns a handle with ``.address``, ``.gateway``,
+    ``.set_active_workers()`` and ``.close()``."""
+    gateway = ShardedGateway(address, replicas, workers=workers,
+                             counters=counters, timer=timer,
+                             **kwargs).start()
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=gateway.serve_forever, kwargs={"stop_event": stop},
+        daemon=True, name="bjx-sharded-gateway",
+    )
+    thread.start()
+    watchdog = None
+    if supervise:
+        from blendjax.btt.watchdog import FleetWatchdog
+
+        watchdog = FleetWatchdog(
+            gateway, interval=watchdog_interval_s, restart=True,
+            on_death=gateway.notify_worker_death,
+            on_respawn=gateway.notify_worker_respawn,
+        )
+        watchdog.start()
+    return _LocalShardedHandle(gateway, thread, stop, watchdog)
 
 
 def main(argv=None):

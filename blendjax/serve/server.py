@@ -950,9 +950,11 @@ class PolicyServer:
         logger.info("policy server: weights v%d hot-swapped (step %d)",
                     snap.version, snap.step)
 
-    def _finish(self, ident, msg, reply, *, span_name, t0_us):
+    def _finish(self, ident, msg, reply, *, span_name, t0_us,
+                ding=True):
         """Stamp correlation id + span + weight version, cache mutating
-        replies, send."""
+        replies, send.  ``ding=False`` defers the shm doorbell to the
+        caller's burst flush (the batched multi-record wake)."""
         st = self._models.get(msg.get("model") or self._default_id)
         if st is not None and st.weight_version is not None:
             # the EXECUTING model's version (a co-hosted model the bus
@@ -975,9 +977,47 @@ class PolicyServer:
                 self._reply_cache[mid] = reply
                 while len(self._reply_cache) > self._reply_cache_depth:
                     self._reply_cache.popitem(last=False)
-        self._send(ident, reply)
+        self._send(ident, reply, ding=ding)
 
-    def _send(self, ident, reply):
+    def _shm_gather_send(self, chan, reply, ding=True):
+        """Gather-into-ring reply: reserve the ring record up front and
+        land the reply's array leaves DIRECTLY in it (``begin_send``
+        views) instead of staging them through ``encode`` + the
+        ``send_frames`` memcpy — the replay shard's zero-copy reply
+        discipline on the serve reply path.  False defers to the
+        generic send (array-less reply, ring full/oversized, old
+        native layer)."""
+        bufs = []
+        header = wire.strip_arrays(reply, bufs)
+        if not bufs:
+            return False
+        head_bytes = wire.dumps(header)
+        sizes = [len(head_bytes)] + [b.nbytes for b in bufs]
+        views = self._shm.begin_send(chan, sizes)
+        if views is None:
+            return False
+        done = False
+        try:
+            views[0][:] = np.frombuffer(head_bytes, np.uint8)
+            for b, dst in zip(bufs, views[1:]):
+                if b.nbytes:
+                    dst[:] = b.view(np.uint8).reshape(-1)
+            done = True
+        finally:
+            if not done:
+                # a torn record with an intact header would decode as
+                # WRONG data — poison the header so the client drops
+                # the record (its same-mid retry re-fetches from the
+                # reply cache), then publish: the reservation must
+                # never dangle
+                views[0][: min(8, len(head_bytes))] = 0
+            try:
+                self._shm.commit_send(chan, ding=ding)
+            except OSError:
+                pass  # channel died mid-reply: the retry re-fetches
+        return True
+
+    def _send(self, ident, reply, ding=True):
         import zmq
 
         if ident is not None and getattr(ident, "shm_channel", False):
@@ -985,8 +1025,10 @@ class PolicyServer:
             # the same channel (a dead/full channel is dropped — the
             # client demotes to ZMQ and its same-mid retry re-fetches
             # from the reply cache)
-            if self._shm is not None and self._shm.send(
-                ident, reply, raw_buffers=True
+            if self._shm is not None and (
+                self._shm_gather_send(ident, reply, ding=ding)
+                or self._shm.send(ident, reply, raw_buffers=True,
+                                  ding=ding)
             ):
                 self.counters.incr("serve_replies")
             return
@@ -1162,8 +1204,14 @@ class PolicyServer:
             reply = {"pred": np.ascontiguousarray(preds[j])}
             if pos_before[j] is not None:
                 reply["pos"] = pos_before[j]
+            # deferred doorbells: the whole batch's shm replies ride
+            # ONE wake per channel (flushed below), not one ding per
+            # record
             self._finish(ent.ident, ent.msg, reply,
-                         span_name="serve:step", t0_us=ent.t0_us)
+                         span_name="serve:step", t0_us=ent.t0_us,
+                         ding=False)
+        if self._shm is not None:
+            self._shm.flush_bells()
         self.timer.add("reply", time.perf_counter() - t_reply)
         return more
 

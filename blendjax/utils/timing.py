@@ -193,12 +193,32 @@ SERVE_EVENTS = (
 #: stale-lease error (the client ``reset()``s onto a healthy replica);
 #: ``gateway_drains`` — replicas put into drain (no fresh episodes,
 #: live ones finish).
+#: The sharded data plane (front/worker/control split, docs/serving.md)
+#: adds:
+#: ``gateway_worker_deaths`` — gateway worker processes the watchdog
+#: reported dead (SIGKILL, crash);
+#: ``gateway_worker_respawns`` — worker processes relaunched by the
+#: watchdog and re-admitted by the control plane;
+#: ``gateway_lease_rehash`` — lease-owned requests the front answered
+#: for a dead worker with the actionable stale-lease error (the
+#: client's ``reset()`` re-hashes onto a live worker);
+#: ``gateway_snapshot_applies`` — versioned control-state snapshots a
+#: worker adopted (replica health/drain/canary verdicts published by
+#: the control plane; stale versions are ignored, not counted);
+#: ``gateway_snapshot_publishes`` — snapshot versions the control plane
+#: published to its workers (one count per version, not per worker);
+#: ``gateway_front_relays`` — client requests the front relayed to a
+#: worker on its behalf (rendezvous, proxied clients); direct-dialed
+#: steady-state traffic never lands here.
 GATEWAY_EVENTS = (
     "gateway_requests", "gateway_replies", "gateway_errors",
     "gateway_cache_hits", "gateway_dup_inflight",
     "gateway_routed", "gateway_affinity_hits", "gateway_rebalances",
     "gateway_replica_quarantined", "gateway_replica_respawns",
     "gateway_stale_lease_redirects", "gateway_drains",
+    "gateway_worker_deaths", "gateway_worker_respawns",
+    "gateway_lease_rehash", "gateway_snapshot_applies",
+    "gateway_snapshot_publishes", "gateway_front_relays",
 )
 
 #: Canonical weight-bus event names (see docs/weight_bus.md).  Same

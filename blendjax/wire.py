@@ -153,6 +153,15 @@ def _restore_arrays(obj, frames):
     return obj
 
 
+def strip_arrays(data: dict, bufs: list) -> dict:
+    """Public half of the raw-buffer encoding: replace ndarray leaves
+    with placeholder headers, appending each (contiguous) array to
+    ``bufs``.  Gather-into-ring senders use it to learn a reply's frame
+    layout BEFORE reserving the ring record, then land each array in
+    its reserved view instead of staging through :func:`encode`."""
+    return _strip_arrays(data, bufs)
+
+
 def encode(data: dict, raw_buffers: bool = False) -> list:
     """Encode a message dict into a list of ZMQ frames."""
     if not raw_buffers:

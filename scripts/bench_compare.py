@@ -62,6 +62,10 @@ DEFAULT_FLOORS = {
     "serve_prefill_x": 0.80,        # batched prefill admission vs serial
     "gateway_qps": 0.80,            # serve-fleet aggregate through the gateway
     "gateway_scale_x": 0.80,        # QPS at N replicas over 1 (drained fleet)
+    # sharded data plane: QPS at N gateway workers over 1 (same fleet,
+    # same worker processes, set_active_workers(1) arm) — the
+    # front/worker split's whole claim, so it gets a tighter floor
+    "gateway_shard_x": 0.85,
     # live weight rollouts must stay ~free for serving traffic: QPS in
     # the buckets around a hot-swap over steady state (docs/weight_bus.md)
     "weight_swap_qps_dip_x": 0.80,
@@ -155,7 +159,8 @@ def _flatten(doc, metrics):
                 metrics[k] = float(sb[k])
     gb = doc.get("gateway_bench")
     if isinstance(gb, dict):
-        for k in ("gateway_qps", "gateway_p99_ms", "gateway_scale_x"):
+        for k in ("gateway_qps", "gateway_p99_ms", "gateway_scale_x",
+                  "gateway_shard_x"):
             if isinstance(gb.get(k), (int, float)) \
                     and not isinstance(gb.get(k), bool):
                 metrics[k] = float(gb[k])

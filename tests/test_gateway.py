@@ -601,6 +601,226 @@ def test_kill_one_replica_of_three_respawn_exactly_once():
 
 
 # ---------------------------------------------------------------------------
+# sharded data plane chaos (docs/serving.md "The sharded gateway")
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_kill_one_gateway_worker_exactly_once():
+    """THE sharded-gateway chaos contract (ISSUE-16): SIGKILL 1 of 3
+    gateway WORKER processes mid-traffic.  Clients direct-dialed onto
+    the survivors keep stepping with ZERO errors — their traffic never
+    touches the dead worker or the front.  The victim's clients observe
+    only timeouts (the dead direct dial), then fall back to the front,
+    which answers their stale partition with the ONE actionable
+    stale-lease error (``reset() and resume``); after ``reset()`` they
+    land on a live worker and every ACKED request was applied exactly
+    once (the position witness: each acked prediction equals
+    ``obs @ W + k`` with k the acks since that episode's reset — a
+    double- or un-applied step shifts every later position).  The
+    watchdog respawns the victim under its parent-pinned address and
+    shm base; counters pin deaths, respawns and the stale-lease path;
+    no ``/dev/shm`` leak survives the close."""
+    from blendjax.btt.chaos import kill_instance
+    from blendjax.serve import ServeClient, ServerFleet
+    from blendjax.serve.gateway import start_sharded_gateway_thread
+
+    gw_counters = EventCounters()
+    obs = np.arange(4, dtype=np.float32)
+    w = _ref_w(0)
+    with ServerFleet(2, model="linear", obs_dim=4, slots=16) as fleet:
+        gw = start_sharded_gateway_thread(
+            fleet.addresses, workers=3, counters=gw_counters,
+            scrape_interval_s=0.15, watchdog_interval_s=0.2,
+        )
+        bases = list(gw.gateway._wbases)
+        try:
+            clients, acked = [], []
+
+            def admit():
+                c = ServeClient(
+                    gw.address, timeoutms=600,
+                    fault_policy=FaultPolicy(
+                        max_retries=1, backoff_base=0.05,
+                        backoff_max=0.2, circuit_threshold=0,
+                        seed=len(clients),
+                    ),
+                    counters=EventCounters(),
+                )
+                c.reset()
+                clients.append(c)
+                acked.append(0)
+
+            for _ in range(6):
+                admit()
+            # fresh traffic hashes by correlation id: with 6 episodes
+            # the workers are almost surely not all the same, but the
+            # test must not depend on hash luck — admit a few more
+            # until the victim's partition AND a survivor both exist
+            while (len({c.gw_worker for c in clients}) < 2
+                   and len(clients) < 12):
+                admit()
+            tags = {c.gw_worker for c in clients}
+            assert len(tags) >= 2, tags
+
+            def acked_step(i):
+                r = clients[i].step(obs)
+                np.testing.assert_allclose(
+                    r["pred"], obs @ w + np.float32(acked[i])
+                )
+                acked[i] += 1
+
+            for i in range(len(clients)):
+                acked_step(i)
+                acked_step(i)
+            victim_tag = clients[0].gw_worker
+            survivors = [i for i, c in enumerate(clients)
+                         if c.gw_worker != victim_tag]
+            on_victim = [i for i, c in enumerate(clients)
+                         if c.gw_worker == victim_tag]
+            kill_instance(gw.gateway, int(victim_tag[2:]))
+            # drive traffic through the outage: survivors must not see
+            # a single error; the victim's clients ride timeouts ->
+            # front fallback -> ONE stale-lease error -> reset -> resume
+            stale_errors, survivor_errors = 0, 0
+            for i in range(len(clients)):
+                deadline = time.monotonic() + 30
+                done = 0
+                while time.monotonic() < deadline and done < 3:
+                    try:
+                        acked_step(i)
+                        done += 1
+                    except TimeoutError:
+                        if i in survivors:
+                            survivor_errors += 1
+                        continue
+                    except RuntimeError as exc:
+                        assert "reset() and resume" in str(exc), exc
+                        if i in survivors:
+                            survivor_errors += 1
+                        stale_errors += 1
+                        while time.monotonic() < deadline:
+                            try:
+                                clients[i].reset(timeout_ms=800)
+                                acked[i] = 0
+                                break
+                            except (TimeoutError, RuntimeError):
+                                time.sleep(0.1)
+                assert done == 3, f"client {i} never recovered"
+            assert survivor_errors == 0
+            assert stale_errors >= 1
+            assert on_victim  # the stale path was actually exercised
+            # the respawn rejoined under its pinned identity: wait for
+            # its first answered scrape, then pin the counters
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                snap = _gateway_counts(gw_counters)
+                if (snap.get("gateway_worker_respawns", 0) >= 1
+                        and all(x.alive for x in gw.gateway._workers)):
+                    break
+                time.sleep(0.1)
+            assert snap.get("gateway_worker_deaths", 0) >= 1, snap
+            assert snap.get("gateway_worker_respawns", 0) >= 1, snap
+            assert all(x.alive for x in gw.gateway._workers)
+            # the actionable error came off the stale partition: the
+            # front's dead-worker answer (gateway_lease_rehash) or the
+            # respawned worker's unknown-lease answer — the merged
+            # fleet view carries both, but a worker-side increment only
+            # reaches it on the NEXT answered scrape, so wait one out
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                merged = gw.gateway.gateway_counters()
+                if merged.get("gateway_stale_lease_redirects", 0) >= 1:
+                    break
+                time.sleep(0.1)
+            assert merged.get("gateway_stale_lease_redirects", 0) >= 1, \
+                merged
+            for c in clients:
+                c.close()
+        finally:
+            gw.close()
+    # PR-12 hygiene through the sharded plane: the SIGKILLed worker ran
+    # no cleanup, but its parent-pinned base prefix was swept before
+    # the respawn and again at close
+    from blendjax.btt.shm_rpc import leaked_objects
+
+    for base in bases:
+        if base is not None:
+            assert not leaked_objects(base), leaked_objects(base)
+
+
+@pytest.mark.chaos
+def test_exactly_once_through_sharded_front_with_wire_faults():
+    """Wire faults between client and the SHARDED front (ChaosProxy:
+    dropped replies, duplicated requests) still yield exactly one
+    applied step per submitted request.  The client is pinned to the
+    front (``follow_redirects=False``) so every message rides the
+    relay path: the front re-forwards a same-mid retry to the SAME
+    worker (route cache), and the worker's dedupe/reply cache answers
+    executed retries — the front itself holds no reply cache."""
+    from blendjax.btt.chaos import ChaosProxy
+    from blendjax.serve import LinearModel, ServeClient, start_server_thread
+    from blendjax.serve.gateway import start_sharded_gateway_thread
+
+    counters = EventCounters()
+    obs = np.arange(4, dtype=np.float32)
+    ref = LinearModel(obs_dim=4, slots=2, seed=0)
+    ref.reset_rows(np.asarray([0]))
+    h = start_server_thread(
+        LinearModel(obs_dim=4, slots=2, seed=0), counters=EventCounters()
+    )
+    proxy = None
+    try:
+        with start_sharded_gateway_thread(
+            [h.address], workers=2, counters=counters,
+            scrape_interval_s=0.1, supervise=False,
+        ) as gw:
+            proxy = ChaosProxy(gw.address)
+            client = ServeClient(
+                proxy.address,
+                fault_policy=FaultPolicy(
+                    max_retries=4, backoff_base=0.02,
+                    backoff_max=0.1, circuit_threshold=0, seed=1,
+                ),
+                counters=counters, timeoutms=600, shm=False,
+                follow_redirects=False,
+            )
+            client.reset()
+            preds = []
+            for t in range(16):
+                if t == 4:
+                    proxy.drop_next("down")  # lose a reply -> retry
+                if t == 9:
+                    proxy.dup_next("up")     # duplicate a request
+                preds.append(client.step(obs)["pred"])
+            want = [ref.step_rows(np.asarray([0]), obs[None])[0]
+                    for _ in range(16)]
+            np.testing.assert_allclose(np.stack(preds), np.stack(want))
+            snap = counters.snapshot()
+            assert snap.get("retries", 0) >= 1
+            assert snap.get("gateway_front_relays", 0) >= 16
+            # the retry was healed on the worker side, not by accident:
+            # its dedupe or reply cache fired.  Worker counters reach
+            # the front on the scrape cycle — wait one out
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                merged = gw.gateway.gateway_counters()
+                if (merged.get("gateway_cache_hits", 0)
+                        + merged.get("gateway_dup_inflight", 0)) >= 1:
+                    break
+                time.sleep(0.05)
+            assert (
+                merged.get("gateway_cache_hits", 0)
+                + merged.get("gateway_dup_inflight", 0)
+            ) >= 1, merged
+            client.close()
+    finally:
+        if proxy is not None:
+            proxy.close()
+        h.close()
+
+
+# ---------------------------------------------------------------------------
 # bench schema + headline carry (satellites)
 # ---------------------------------------------------------------------------
 
@@ -621,6 +841,40 @@ def test_gateway_bench_emits_locked_schema():
     for stage in GATEWAY_STAGES:
         assert stage in rec["stages"], stage
     assert rec["gateway_counters"].get("gateway_drains", 0) >= 1
+    # 1-worker mode: the shard-phase keys ride as None, never missing
+    assert rec["gateway_workers"] == 1
+    assert rec["gateway_qps_1worker"] is None
+    assert rec["gateway_qps_nworker"] is None
+    assert rec["gateway_shard_x"] is None
+    assert rec["shard_profile"] is None
+
+
+@pytest.mark.chaos
+def test_sharded_gateway_bench_emits_shard_phase():
+    """``--gateway-workers 2`` adds the shard phase: same locked
+    schema, with the 1-worker/N-worker pair, its ratio and the
+    shard-phase fleet profile populated (docs/serving.md)."""
+    from benchmarks._common import GATEWAY_BENCH_KEYS
+    from benchmarks.serve_benchmark import measure_gateway
+
+    rec = measure_gateway(seconds=2.4, clients=4, replicas=2,
+                          work_us=100, rounds=1, gateway_workers=2,
+                          shard_work_us=50, shard_obs_dim=16,
+                          shard_clients=4)
+    assert all(k in rec for k in GATEWAY_BENCH_KEYS), [
+        k for k in GATEWAY_BENCH_KEYS if k not in rec
+    ]
+    assert rec["gateway_workers"] == 2
+    assert rec["gateway_qps"] > 0
+    assert rec["gateway_qps_1worker"] > 0
+    assert rec["gateway_qps_nworker"] > 0
+    assert rec["gateway_shard_x"] is not None
+    assert len(rec["shard_pair_ratios"]) == 1
+    assert rec["shard_profile"] == {
+        "work_us": 50, "obs_dim": 16, "clients": 4,
+    }
+    # the sharded plane's lifecycle showed up in the merged counters
+    assert rec["gateway_counters"].get("gateway_front_relays", 0) >= 1
 
 
 def test_bench_headline_carries_gateway_metrics():
@@ -634,6 +888,11 @@ def test_bench_headline_carries_gateway_metrics():
         "gateway_qps": 834.0, "gateway_qps_1replica": 372.0,
         "gateway_p50_ms": 18.0, "gateway_p99_ms": 47.1,
         "gateway_scale_x": 2.24, "pair_ratios": [2.2, 2.3],
+        "gateway_workers": 2, "gateway_qps_1worker": 610.0,
+        "gateway_qps_nworker": 845.0, "gateway_shard_x": 1.39,
+        "shard_pair_ratios": [1.3, 1.4],
+        "shard_profile": {"work_us": 500, "obs_dim": 128,
+                          "clients": 12},
         "gateway_counters": {}, "stages": {},
     }
     sb = {
@@ -646,9 +905,12 @@ def test_bench_headline_carries_gateway_metrics():
     out = bench.assemble({}, host_fallback=lambda: 1.0, serve_bench=sb,
                          gateway_bench=gb)
     assert out["gateway_bench"]["gateway_scale_x"] == 2.24
+    assert out["gateway_bench"]["gateway_shard_x"] == 1.39
     assert out["serve_bench"]["serve_prefill_x"] == 14.9
     line = bench.headline(out)
     assert line["gateway_qps"] == 834.0
+    assert line["gateway_shard_x"] == 1.39
+    assert len(json.dumps(line).encode()) <= bench.HEADLINE_BYTE_BUDGET
     assert line["gateway_p99_ms"] == 47.1
     assert line["gateway_scale_x"] == 2.24
     assert line["serve_prefill_x"] == 14.9

@@ -168,6 +168,8 @@ def test_restart_heals_shm_stream(fake_blender):
         ) as bl:
             addr = bl.launch_info.addresses["DATA"][0]
             assert addr.startswith("shm://")
+            launch_base = bl._shm_base  # nonce'd per-launch prefix
+            assert f"shm://{launch_base}-DATA-12700" == addr
             shm_path = "/dev/shm/" + nring.shm_name_from_address(addr).lstrip("/")
             with FleetWatchdog(bl, interval=0.2, restart=True) as wd:
                 ds = RemoteIterableDataset(
@@ -194,10 +196,11 @@ def test_restart_heals_shm_stream(fake_blender):
                 assert wd.deaths and wd.deaths[0][2] is True
             # unwind the iterator before the launcher tears down
             it.close()
-        # teardown hygiene: the launcher unlinked its fleet's ring even
-        # though the (respawned) producer was killed without cleanup
+        # teardown hygiene: the launcher swept its whole nonce'd base
+        # prefix even though the (respawned) producer was killed
+        # without cleanup — nothing under the prefix survives
         assert not os.path.exists(shm_path)
-        assert not glob.glob("/dev/shm/blendjax-DATA-12700-*")
+        assert not glob.glob(f"/dev/shm/{launch_base}*")
     finally:
         try:
             os.unlink("/dev/shm/blendjax-DATA-12700")
