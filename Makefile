@@ -9,10 +9,11 @@ PYTHON ?= python
 # tier1 uses pipefail/PIPESTATUS (bash); everything else is sh-safe too
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos chaos-replay chaos-learner blender-tests \
+.PHONY: test tier1 chaos chaos-replay chaos-learner chaos-autoscale \
+	blender-tests \
 	tpu-tests bench rlbench rlbench-sharded replaybench shmbench \
 	servebench gatewaybench weightbench scenariobench habench \
-	multichip dryrun benchdiff obsdemo
+	autoscalebench multichip dryrun benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -71,6 +72,20 @@ chaos-learner:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		BJX_POSTMORTEM_DIR=obs_artifacts \
 		$(PYTHON) -m pytest tests/test_ha.py -m chaos -q -rs
+
+# The autoscale chaos pack (tests/test_autoscale.py): the three SIGKILL
+# drills every live resize must survive — a serve replica killed
+# MID-DRAIN (watchdog respawn, drain flag survives quarantine, the
+# scale-down still completes), the controller killed MID-DECISION (a
+# fresh controller adopts the observed in-flight drain instead of
+# double-acting), and the NEW replay shard killed MID-HANDOFF (the
+# handoff aborts whole, the ownership map untouched, the source keeps
+# serving).  Subset of `make chaos` (same marker).  See
+# docs/autoscaling.md.
+chaos-autoscale:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		BJX_POSTMORTEM_DIR=obs_artifacts \
+		$(PYTHON) -m pytest tests/test_autoscale.py -m chaos -q -rs
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.
@@ -222,6 +237,16 @@ scenariobench:
 habench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		$(PYTHON) benchmarks/ha_benchmark.py
+
+# Autoscale microbench (docs/autoscaling.md): resize_settle_s (the
+# controller's scale-up decision -> fleet verified healthy at the new
+# size under steady client traffic, healthy window included — lower is
+# better, bench_compare ceiling) and drain_error_x (client-observed
+# error fraction across the drain -> verify -> retire scale-down —
+# MUST be 0.0).  One JSON line, both carried in the bench.py headline.
+autoscalebench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) benchmarks/autoscale_benchmark.py
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

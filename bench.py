@@ -464,12 +464,31 @@ def main():
         )
         ha_bench = ha_lines[-1] if ha_lines else None
 
+    # tenth configuration: the autoscale plane (docs/autoscaling.md) —
+    # resize_settle_s (scale-up decision -> fleet verified healthy at
+    # the new size under steady traffic) and drain_error_x (client-
+    # observed error fraction across a drain scale-down — must be 0).
+    # Jax-free.
+    autoscale_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 30:
+        as_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "autoscale_benchmark.py"),
+            ],
+            rl_env,
+            min(90, remaining),
+        )
+        autoscale_bench = as_lines[-1] if as_lines else None
+
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
                    replay_bench=replay_bench, rl_sharded=rl_sharded,
                    serve_bench=serve_bench, gateway_bench=gateway_bench,
                    weight_bench=weight_bench,
-                   scenario_bench=scenario_bench, ha_bench=ha_bench)
+                   scenario_bench=scenario_bench, ha_bench=ha_bench,
+                   autoscale_bench=autoscale_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -513,6 +532,7 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
+    ("resize_settle_s", "drain_error_x"),
     ("ckpt_overhead_x", "learner_recovery_s"),
     ("scenario_hetero_x", "serve_mix_p99_ms"),
     ("weight_swap_ms", "weight_swap_qps_dip_x"),
@@ -628,6 +648,14 @@ def headline(out):
             line["ckpt_overhead_x"] = ha["ckpt_overhead_x"]
         if ha.get("learner_recovery_s") is not None:
             line["learner_recovery_s"] = ha["learner_recovery_s"]
+    asb = out.get("autoscale_bench")
+    if asb:
+        # the autoscale headline: scale-up decision -> verified-healthy
+        # settle, and the zero-client-visible-errors drain contract
+        if asb.get("resize_settle_s") is not None:
+            line["resize_settle_s"] = asb["resize_settle_s"]
+        if asb.get("drain_error_x") is not None:
+            line["drain_error_x"] = asb["drain_error_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -681,7 +709,8 @@ def headline(out):
 def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
              rl_sharded=None, serve_bench=None, gateway_bench=None,
-             weight_bench=None, scenario_bench=None, ha_bench=None):
+             weight_bench=None, scenario_bench=None, ha_bench=None,
+             autoscale_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -749,6 +778,21 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
                 "stages",
             )
             if k in ha_bench
+        }
+    if autoscale_bench \
+            and autoscale_bench.get("phase") == "autoscale_bench":
+        # the autoscale record: decision-to-settle for a verified
+        # scale-up and the drain scale-down's client-visible error
+        # ledger — see benchmarks/autoscale_benchmark.py
+        extras["autoscale_bench"] = {
+            k: autoscale_bench[k]
+            for k in (
+                "replicas", "clients", "window_s",
+                "resize_settle_s", "drain_settle_s",
+                "drain_error_x", "drain_requests", "drain_errors",
+                "autoscale_counters", "stages",
+            )
+            if k in autoscale_bench
         }
     if weight_bench and weight_bench.get("phase") == "weight_bench":
         # the live-rollout cost record: publish -> first-serving-reply

@@ -185,6 +185,27 @@ HA_BENCH_KEYS = (
 )
 
 
+#: Result-schema keys every ``autoscale_benchmark.py`` JSON line
+#: carries (phase ``autoscale_bench``); ``bench.py`` keys off these and
+#: ``tests/test_autoscale.py`` locks emission against this tuple.
+#: ``resize_settle_s`` is the headline: autoscale decision (the
+#: controller's ``grow``) -> fleet verified healthy at the new size
+#: under steady client traffic, healthy window included (lower is
+#: better, ceiling-guarded on the trajectory in bench_compare);
+#: ``drain_error_x`` is client-observed error fraction across the
+#: scale-DOWN transition (drain -> verify -> retire) — the
+#: zero-client-visible-errors contract, MUST be 0.0;
+#: ``drain_settle_s`` is the same decision-to-settle measure for the
+#: scale-down.
+AUTOSCALE_BENCH_KEYS = (
+    "replicas", "clients", "obs_dim", "window_s",
+    "resize_settle_s", "drain_settle_s",
+    "drain_error_x", "drain_requests", "drain_errors",
+    "autoscale_counters",
+    "stages",            # autoscale_resize / autoscale_drain summaries
+)
+
+
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)
 

@@ -1,0 +1,216 @@
+"""Autoscale microbench (docs/autoscaling.md): the two numbers live
+resizing owes the headline.
+
+- ``resize_settle_s`` — the controller's scale-up decision (``grow``)
+  to the transition COMMITTING (newcomer spawned, admitted to the
+  gateway, verified healthy through the window) under steady client
+  traffic.  The healthy window is part of the cost on purpose: a
+  resize is not done until it is verified.  Lower is better,
+  ceiling-guarded on the trajectory (bench_compare).
+- ``drain_error_x`` — client-observed error fraction across the
+  scale-DOWN transition (drain the victim, wait out its leases, verify
+  the shrunk route set, retire the process).  The drain lifecycle's
+  contract is ZERO client-visible errors, so this must be exactly 0.0
+  (a hard floor/ceiling at 0 in bench_compare).
+
+One JSON line (phase ``autoscale_bench``; keys locked by
+``benchmarks/_common.AUTOSCALE_BENCH_KEYS``), carried into the
+``bench.py`` headline.  Run via ``make autoscalebench``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
+import numpy as np  # noqa: E402
+
+
+class _Traffic:
+    """Steady background episode traffic against the gateway front:
+    reset -> a few steps -> close, forever, counting requests and
+    CLIENT-VISIBLE errors (anything that surfaces past the fault
+    policy)."""
+
+    def __init__(self, address, n_clients=4, episode_len=4):
+        self.address = address
+        self.n_clients = int(n_clients)
+        self.episode_len = int(episode_len)
+        self.requests = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+
+    def _run(self, i):
+        from blendjax.serve import ServeClient
+
+        obs = np.arange(4, dtype=np.float32)
+        c = ServeClient(self.address, timeoutms=5000)
+        try:
+            while not self._stop.is_set():
+                try:
+                    c.reset()
+                    n = 1
+                    for _ in range(self.episode_len):
+                        c.step(obs)
+                        n += 1
+                    c.close_episode()
+                    n += 1
+                    with self._lock:
+                        self.requests += n
+                except Exception:  # noqa: BLE001 - the thing we count
+                    with self._lock:
+                        self.errors += 1
+                    time.sleep(0.05)
+        finally:
+            c.close()
+
+    def counts(self):
+        with self._lock:
+            return self.requests, self.errors
+
+    def __enter__(self):
+        for i in range(self.n_clients):
+            t = threading.Thread(target=self._run, args=(i,),
+                                 daemon=True, name=f"bjx-asb-client{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        return False
+
+
+def _drive(ctl, until, deadline_s=60.0, interval_s=0.05):
+    """Tick the controller until it reports an action in ``until``;
+    returns (action, wall seconds from the first tick)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        action = ctl.tick()
+        if action in until:
+            return action, time.monotonic() - t0
+        time.sleep(interval_s)
+    raise TimeoutError(f"controller never reached {until}")
+
+
+def measure(replicas=2, clients=4, window_s=0.75):
+    from blendjax.autoscale import AutoscaleController
+    from blendjax.serve import ServerFleet
+    from blendjax.serve.gateway import start_gateway_thread
+    from blendjax.utils.timing import EventCounters, StageTimer
+
+    counters = EventCounters()
+    timer = StageTimer()
+    out = {}
+    with ServerFleet(replicas, model="linear", obs_dim=4,
+                     slots=16) as fleet:
+        with start_gateway_thread(
+            fleet.addresses, counters=counters,
+            scrape_interval_s=0.1,
+        ) as gw:
+            with _Traffic(gw.address, n_clients=clients) as traffic:
+                # let the fleet serve steadily before any decision
+                time.sleep(0.5)
+
+                # -- scale-up: decision -> verified at the new size --
+                up = AutoscaleController(
+                    gw.gateway, fleet,
+                    min_replicas=replicas, max_replicas=replicas + 1,
+                    up_queue_depth=-1.0,       # always wants up
+                    healthy_window_s=window_s, min_requests=10,
+                    cooldown_up_s=0.0, cooldown_down_s=0.0,
+                    # tiny-model p99s jitter at microsecond scale; the
+                    # bench verdict is the error-rate contract
+                    max_p99_x=1e9,
+                    counters=counters, timer=timer,
+                )
+                t0 = time.monotonic()
+                action, _ = _drive(up, {"grow"})
+                action, _ = _drive(up, {"scale_up", "rollback"})
+                if action != "scale_up":
+                    raise RuntimeError(
+                        "scale-up rolled back under bench traffic"
+                    )
+                out["resize_settle_s"] = round(time.monotonic() - t0, 3)
+
+                # -- scale-down: drain under load, zero errors --------
+                req0, err0 = traffic.counts()
+                down = AutoscaleController(
+                    gw.gateway, fleet,
+                    min_replicas=replicas, max_replicas=replicas + 1,
+                    up_queue_depth=1e9, up_p99_ms=1e9,
+                    down_queue_depth=1e9, down_p99_ms=1e9,  # always down
+                    healthy_window_s=window_s, min_requests=10,
+                    cooldown_up_s=0.0, cooldown_down_s=0.0,
+                    drain_grace_s=30.0,
+                    counters=counters, timer=timer,
+                )
+                t0 = time.monotonic()
+                action, _ = _drive(down, {"drain"})
+                action, _ = _drive(down, {"scale_down", "rollback"})
+                if action != "scale_down":
+                    raise RuntimeError(
+                        "scale-down rolled back under bench traffic"
+                    )
+                out["drain_settle_s"] = round(time.monotonic() - t0, 3)
+                # let in-flight episodes land before reading the ledger
+                time.sleep(0.25)
+                req1, err1 = traffic.counts()
+                d_req, d_err = req1 - req0, err1 - err0
+                out["drain_requests"] = d_req
+                out["drain_errors"] = d_err
+                out["drain_error_x"] = round(
+                    d_err / max(1, d_req), 6
+                )
+    out["autoscale_counters"] = {
+        k: counters.get(k) for k in (
+            "autoscale_scale_ups", "autoscale_scale_downs",
+            "autoscale_rollbacks", "autoscale_replica_spawns",
+            "autoscale_replicas_retired",
+        )
+    }
+    out["stages"] = timer.summary()
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--window-s", type=float, default=0.75)
+    args = ap.parse_args(argv)
+
+    out = {
+        "phase": "autoscale_bench",
+        "replicas": args.replicas,
+        "clients": args.clients,
+        "obs_dim": 4,
+        "window_s": args.window_s,
+        "resize_settle_s": None,
+        "drain_settle_s": None,
+        "drain_error_x": None,
+        "drain_requests": None,
+        "drain_errors": None,
+        "autoscale_counters": None,
+        "stages": None,
+    }
+    out.update(measure(replicas=args.replicas, clients=args.clients,
+                       window_s=args.window_s))
+    print(json.dumps(out), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Live autoscaling (docs/autoscaling.md): resize the serve fleet and
+the replay shard set WHILE the system serves traffic, every transition
+verified and reversible.
+
+- :class:`AutoscaleController` — the serve-tier control loop: scraped
+  queue depth / p99 drive ``grow``/``drain``/``retire`` decisions with
+  hysteresis bands, per-direction cooldowns and a post-action healthy
+  window that ROLLS BACK a resize that regressed error rate or latency
+  (the :class:`~blendjax.weights.controller.WeightBusController`
+  promote/rollback template pointed at capacity instead of weights).
+- :func:`reshard_replay` — the replay-tier resize: grow the shard
+  fleet by one process and hand it a slot range crash-exactly
+  (checkpoint copy + ``written_since`` delta + locked cutover), the
+  draw stream never pausing and staying bit-identical over unmoved
+  ranges.
+"""
+
+from blendjax.autoscale.controller import AutoscaleController
+from blendjax.autoscale.reshard import reshard_replay
+
+__all__ = ["AutoscaleController", "reshard_replay"]

@@ -272,6 +272,11 @@ class BlenderLauncher:
         info = self.launch_info
         if info is None:
             raise RuntimeError("Not launched.")
+        if info.processes[idx] is None:
+            raise RuntimeError(
+                f"instance {idx} is retired; a retired slot is never "
+                "respawned"
+            )
         new = subprocess.Popen(
             info.commands[idx],
             shell=False,
@@ -281,6 +286,24 @@ class BlenderLauncher:
         info.processes[idx] = new
         logger.info("Respawned instance %d as pid %d", idx, new.pid)
         return new
+
+    def retire(self, idx):
+        """Permanently retire instance ``idx`` (the autoscale
+        scale-down surface): stop its process group and keep the index
+        slot as ``None``, so fleet indices stay stable and a
+        :class:`~blendjax.btt.watchdog.FleetWatchdog` skips the slot
+        instead of respawning it.  Idempotent — retiring a retired
+        slot returns ``False``."""
+        info = self.launch_info
+        if info is None:
+            raise RuntimeError("Not launched.")
+        p = info.processes[idx]
+        if p is None:
+            return False
+        self._stop_process(p)
+        info.processes[idx] = None
+        logger.info("Retired instance %d", idx)
+        return True
 
     def assert_alive(self):
         """Raise if any launched process has exited (reference ``:166-171``)."""
@@ -293,12 +316,15 @@ class BlenderLauncher:
     def wait(self):
         """Block until every launched process terminates."""
         for p in self.launch_info.processes:
-            p.wait()
+            if p is not None:
+                p.wait()
 
     def __exit__(self, exc_type, exc_value, exc_traceback):
         for p in self.launch_info.processes:
-            self._stop_process(p)
-        remaining = [c for c in self._poll() if c is None]
+            if p is not None:
+                self._stop_process(p)
+        remaining = [p for p in self.launch_info.processes
+                     if p is not None and p.poll() is None]
         self._unlink_shm()
         self.launch_info = None
         if remaining:
@@ -333,4 +359,5 @@ class BlenderLauncher:
     def _poll(self):
         if self.launch_info is None or self.launch_info.processes is None:
             return []
-        return [p.poll() for p in self.launch_info.processes]
+        return [None if p is None else p.poll()
+                for p in self.launch_info.processes]

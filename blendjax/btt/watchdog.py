@@ -15,7 +15,10 @@ change and remaps the new generation (rc -4 reopen path).
 from __future__ import annotations
 
 import logging
+import random
 import threading
+
+from blendjax.utils.timing import fleet_counters
 
 logger = logging.getLogger("blendjax")
 
@@ -41,15 +44,30 @@ class FleetWatchdog:
         consumer that probes/re-admits (the serve gateway, a supervisor
         heal loop) re-arms immediately instead of waiting out its next
         poll.
+    respawn_backoff_s / respawn_jitter_s: float
+        Pause inserted before each respawn: ``respawn_backoff_s`` fixed
+        plus ``uniform(0, respawn_jitter_s)`` randomized per member.
+        With N members SIGKILLed in the same poll window (or a box
+        stall), the jitter de-correlates their relaunches so they do
+        not come back in lockstep and stampede the gateway's
+        re-admission scrape.  Applied milliseconds are counted under
+        ``watchdog_backoff_jitter_ms`` so postmortems show the pacing.
+    counters: EventCounters | None
+        Counter sink for ``watchdog_backoff_jitter_ms`` (defaults to
+        the process-wide ``fleet_counters``).
     """
 
     def __init__(self, launcher, interval=1.0, on_death=None, restart=False,
-                 on_respawn=None):
+                 on_respawn=None, respawn_backoff_s=0.0,
+                 respawn_jitter_s=0.05, counters=None):
         self.launcher = launcher
         self.interval = interval
         self.on_death = on_death
         self.restart = restart
         self.on_respawn = on_respawn
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.respawn_jitter_s = float(respawn_jitter_s)
+        self.counters = counters if counters is not None else fleet_counters
         self.deaths = []  # (index, exit_code, restarted)
         self._stop = threading.Event()
         self._thread = None
@@ -80,7 +98,8 @@ class FleetWatchdog:
         info = self.launcher.launch_info
         if info is None or info.processes is None:
             return 0
-        return sum(1 for p in info.processes if p.poll() is None)
+        return sum(1 for p in info.processes
+                   if p is not None and p.poll() is None)
 
     def _run(self):
         while not self._stop.wait(self.interval):
@@ -88,12 +107,26 @@ class FleetWatchdog:
             if info is None or info.processes is None:
                 return
             for idx, proc in enumerate(info.processes):
+                if proc is None:
+                    # retired member (autoscale scale-down): its slot is
+                    # kept so fleet indices stay stable, but there is
+                    # nothing to watch or respawn
+                    continue
                 code = proc.poll()
                 if code is None:
                     continue
                 already = any(d[0] == idx and not d[2] for d in self.deaths)
                 restarted = False
                 if self.restart:
+                    delay = self.respawn_backoff_s + random.uniform(
+                        0.0, self.respawn_jitter_s)
+                    if delay > 0:
+                        self.counters.incr(
+                            "watchdog_backoff_jitter_ms",
+                            max(1, int(delay * 1000.0)),
+                        )
+                        if self._stop.wait(delay):
+                            return
                     try:
                         new = self.launcher.respawn(idx)
                     except Exception:

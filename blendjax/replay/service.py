@@ -778,22 +778,131 @@ class ShardFleet:
         incarnation's ``/dev/shm`` objects (rings, bells — a SIGKILL
         runs no cleanup) are swept FIRST, so generations cannot pile up
         across a chaos run's kill/respawn cycles."""
+        if (self.launch_info is not None
+                and self.launch_info.processes[idx] is None):
+            raise RuntimeError(
+                f"replay shard {idx} is retired; a retired slot is "
+                "never respawned"
+            )
         if self.shm_bases[idx] is not None:
             shm_rpc.unlink_base(self.shm_bases[idx])
         proc = self._spawn(self._cmds[idx])
         self.launch_info.processes[idx] = proc
         return proc
 
+    def grow(self, restore_ckpt=None):
+        """Spawn ONE additional shard process (the storage half of live
+        replay resharding, docs/autoscaling.md).  With ``restore_ckpt``
+        the new shard boots already holding a source shard's rows: the
+        checkpoint file is copied under the new shard's own name before
+        launch, so ``_restore_from_disk`` adopts it (the shard restore
+        path validates format + capacity, not shard id — a handoff IS a
+        copied checkpoint restoring elsewhere).  Without it any stale
+        on-disk state for the new index is removed so the shard boots
+        empty.  Blocks until the shard answers ``hello``; on failure
+        the process is retired and the fleet is unchanged.  Returns
+        ``(idx, address)``."""
+        import shutil
+
+        from blendjax.replay.shard_client import ShardClient, free_port
+
+        if self.launch_info is None:
+            raise RuntimeError("ShardFleet.grow before __enter__")
+        idx = self.num_shards
+        os.makedirs(self.data_dir, exist_ok=True)
+        ckpt = os.path.join(self.data_dir, f"shard_{idx:02d}.ckpt.npz")
+        for stale in glob.glob(os.path.join(
+                self.data_dir, f"shard_{idx:02d}.spill-*.btr")):
+            os.remove(stale)
+        if restore_ckpt is not None:
+            shutil.copyfile(restore_ckpt, ckpt)
+        elif os.path.exists(ckpt):
+            os.remove(ckpt)
+        addr = f"tcp://127.0.0.1:{free_port()}"
+        base = shm_rpc.new_base(f"sf{idx}") if shm_rpc.enabled() else None
+        cmd = [
+            self.python, "-m", "blendjax.replay.service",
+            "--address", addr,
+            "--capacity", str(self.capacity_per_shard),
+            "--shard-id", str(idx),
+            "--dir", str(self.data_dir),
+            "--checkpoint-every", str(self.checkpoint_every),
+        ]
+        if base is not None:
+            cmd += ["--shm-base", base]
+        proc = self._spawn(cmd)
+        self.shm_bases.append(base)
+        self._cmds.append(cmd)
+        self.num_shards = idx + 1
+        self.addresses.append(addr)  # aliased by launch_info (REPLAY)
+        self.launch_info.processes.append(proc)
+        if base is not None:
+            self.launch_info.addresses["REPLAY_SHM"].append(
+                f"shm://{base}"
+            )
+        deadline = time.monotonic() + self.ready_timeout
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"grown replay shard {idx} at {addr} not ready "
+                        f"within {self.ready_timeout:.1f}s"
+                    )
+                client = ShardClient(addr, idx, timeoutms=500)
+                try:
+                    client.rpc("hello", timeout_ms=500)
+                    break
+                except TimeoutError:
+                    continue
+                finally:
+                    client.close()
+        except BaseException:
+            self.retire(idx)
+            raise
+        logger.info("replay shard %d grown at %s (restore_ckpt=%s)",
+                    idx, addr, restore_ckpt)
+        return idx, addr
+
+    def retire(self, idx):
+        """Stop shard ``idx`` and mark its slot retired (``None``): the
+        watchdog skips it and :meth:`respawn` refuses it.  Sweeps its
+        ``/dev/shm`` objects.  Idempotent; returns True when a live
+        process was actually stopped."""
+        procs = self.launch_info.processes if self.launch_info else []
+        p = procs[idx] if 0 <= idx < len(procs) else None
+        if p is not None:
+            # slot goes None BEFORE the kill: a watchdog polling
+            # between the two must see a retired slot, not a death
+            procs[idx] = None
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                try:
+                    p.kill()
+                    p.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+        if idx < len(self.shm_bases) and self.shm_bases[idx] is not None:
+            shm_rpc.unlink_base(self.shm_bases[idx])
+        if p is not None:
+            logger.info("replay shard %d retired", idx)
+        return p is not None
+
     def close(self):
         info = self.launch_info
         if info is None:
             return
         for p in info.processes:
+            if p is None:
+                continue
             try:
                 p.terminate()
             except Exception:  # noqa: BLE001
                 pass
         for p in info.processes:
+            if p is None:
+                continue
             try:
                 p.wait(timeout=5)
             except Exception:  # noqa: BLE001

@@ -68,6 +68,13 @@ def free_port():
     return port
 
 
+class ReshardAborted(RuntimeError):
+    """A live shard handoff (:meth:`ShardedReplay.adopt_shard`) aborted
+    WHOLE: the client's ownership map is untouched and the source shard
+    keeps serving its full range.  The caller (the autoscale reshard
+    orchestrator) retires the would-be shard process."""
+
+
 class ShardRPCError(TimeoutError):
     """A shard RPC failed at the transport level (no reply within the
     policy, connection refused, circuit open).  Subclasses
@@ -227,7 +234,7 @@ class _ShardedStore:
     def write_row(self, slot, row):
         o = self.owner
         self._check_row(row)
-        s = slot // o.shard_capacity
+        s = int(o._owner[slot])
         if o._dead[s]:
             o._journal_row_locked(slot, row)
             return
@@ -235,7 +242,7 @@ class _ShardedStore:
         try:
             o.clients[s].rpc(
                 "append",
-                {"rows": [row], "slots": [slot % o.shard_capacity]},
+                {"rows": [row], "slots": [int(o._local[slot])]},
                 raw_buffers=True,
             )
         except ShardRPCError as exc:
@@ -289,12 +296,12 @@ class _ShardedStore:
             batch[key] = dst
         t0 = time.perf_counter()
         try:
-            shard_of = idx // o.shard_capacity
+            shard_of = o._owner[idx]
             shards = np.unique(shard_of)
             jobs = []
             for s in shards:
                 pos = np.flatnonzero(shard_of == s)
-                jobs.append((int(s), pos, idx[pos] % o.shard_capacity))
+                jobs.append((int(s), pos, o._local[idx[pos]]))
             if len(jobs) > 1 and o._gather_pool is not None:
                 # one RPC per shard, in flight CONCURRENTLY: the
                 # shards' gathers/ring writes overlap each other (and
@@ -473,6 +480,22 @@ class ShardedReplay(ReplayBuffer):
         self._pending = np.zeros(self.capacity, bool)
         self._journal = {}  # global slot -> owned row dict
         self._probe_lock = threading.Lock()
+        #: slot-range ownership map (the live-resharding seam): global
+        #: slot -> owning shard index, and -> its LOCAL slot on that
+        #: shard.  The identity layout (shard s owns the contiguous
+        #: range [s*C, (s+1)*C) with local = global % C) until a
+        #: handoff (:meth:`adopt_shard`) remaps a range onto a new
+        #: shard.  Total capacity — and with it the SumTree, the RNG
+        #: and every draw — NEVER changes under a reshard: only which
+        #: shard serves a slot's storage RPCs does, which is what makes
+        #: the draw stream bit-identical across a resize by
+        #: construction.
+        self._owner = np.repeat(
+            np.arange(self.num_shards, dtype=np.int64),
+            self.shard_capacity,
+        )
+        self._local = (np.arange(self.capacity, dtype=np.int64)
+                       % self.shard_capacity)
         for h in hellos:
             if h is not None and h.get("keys"):
                 # a shard with pre-existing rows: adopt nothing — the
@@ -490,14 +513,23 @@ class ShardedReplay(ReplayBuffer):
 
     # -- shard-range helpers -------------------------------------------------
 
-    def _shard_slice(self, s):
-        lo = s * self.shard_capacity
-        return lo, lo + self.shard_capacity
+    def _owned_slots(self, s):
+        """Global slots shard ``s`` currently owns (contiguous
+        ``[s*C, (s+1)*C)`` until a reshard remaps a range)."""
+        return np.flatnonzero(self._owner == s)
+
+    def _local_to_global(self, s):
+        """Inverse of the ownership map for shard ``s``: its LOCAL slot
+        -> the global slot it backs.  Locals are unique per shard (a
+        handoff moves a range whose locals were already distinct), so
+        the dict is total over owned slots."""
+        owned = self._owned_slots(s)
+        return {int(self._local[g]): int(g) for g in owned}
 
     def _eligible_live_locked(self):
         """Mask of rows drawable right now: eligible AND owned by a live
         shard AND not waiting in the journal."""
-        live = np.repeat(~self._dead, self.shard_capacity)
+        live = ~self._dead[self._owner]
         return self._valid & live & ~self._pending
 
     # -- quarantine / journal / re-admission ---------------------------------
@@ -590,16 +622,16 @@ class ShardedReplay(ReplayBuffer):
                 "re-admission (it would serve wrong rows)"
             )
         shard_seq = int(hello["seq"])
-        lo, hi = self._shard_slice(s)
+        owned = self._owned_slots(s)
         if shard_seq < self._acked[s]:
             # the shard came back OLDER than what it acked (restored a
             # stale checkpoint with no spill tail): rows in its range
             # may be arbitrarily wrong — invalidate everything except
             # the journal (whose rows we still hold) instead of serving
             # ghost data
-            lost = np.flatnonzero(
-                self._valid[lo:hi] & ~self._pending[lo:hi]
-            ) + lo
+            lost = owned[
+                self._valid[owned] & ~self._pending[owned]
+            ]
             for slot in lost:
                 self._valid[slot] = False
                 self._num_valid -= 1
@@ -621,7 +653,7 @@ class ShardedReplay(ReplayBuffer):
         # slot order (idempotent by content — a lost flush ack re-sends
         # the same rows to the same slots)
         slots = sorted(
-            slot for slot in self._journal if lo <= slot < hi
+            slot for slot in self._journal if self._owner[slot] == s
         )
         if slots:
             try:
@@ -630,7 +662,7 @@ class ShardedReplay(ReplayBuffer):
                     {
                         "rows": [self._journal[slot] for slot in slots],
                         "slots": [
-                            slot % self.shard_capacity for slot in slots
+                            int(self._local[slot]) for slot in slots
                         ],
                     },
                     raw_buffers=True,
@@ -767,6 +799,8 @@ class ShardedReplay(ReplayBuffer):
     def _state_arrays_meta_locked(self):
         arrays, meta = super()._state_arrays_meta_locked()
         arrays["pending"] = self._pending
+        arrays["owner"] = self._owner
+        arrays["local"] = self._local
         for slot, row in self._journal.items():
             for key, value in row.items():
                 arrays[f"jrn.{slot}.{key}"] = value
@@ -857,6 +891,12 @@ class ShardedReplay(ReplayBuffer):
             for k, (shape, dt) in (meta.get("schema") or {}).items()
         }
         buf._pending = np.array(arrays["pending"], bool)
+        if "owner" in arrays:
+            # resharded deployments carry an explicit slot-ownership map;
+            # older checkpoints predate it and keep the identity layout
+            # __init__ already built
+            buf._owner = np.array(arrays["owner"], np.int64)
+            buf._local = np.array(arrays["local"], np.int64)
         for arr_name, value in arrays.items():
             if not arr_name.startswith("jrn."):
                 continue
@@ -903,18 +943,18 @@ class ShardedReplay(ReplayBuffer):
         checkpoint (see :meth:`restore` ``reconcile=``): invalidate the
         slots written past the cut so the rewound draw state never
         gathers rows it does not describe."""
-        lo, hi = self._shard_slice(s)
+        inv = self._local_to_global(s)
         reply = self.clients[s].rpc(
             "written_since", {"seq": int(acked_at_cut)}
         )
         if reply["complete"]:
             targets = [
-                lo + int(slot) for slot in reply["slots"]
-                if 0 <= int(slot) < self.shard_capacity
+                inv[int(slot)] for slot in reply["slots"]
+                if int(slot) in inv
             ]
             reason = f"{len(targets)} slots written past the cut"
         else:
-            targets = list(range(lo, hi))
+            targets = [int(g) for g in self._owned_slots(s)]
             reason = (
                 "tail rotated/overflowed past the cut; whole range "
                 "rolled back"
@@ -946,6 +986,183 @@ class ShardedReplay(ReplayBuffer):
             "until the resumed actors rewrite them", self.name, s,
             int(reply["seq"]), int(acked_at_cut), reason, rolled,
         )
+
+    # -- live resharding -----------------------------------------------------
+
+    def adopt_shard(self, new_shard, *, source, cut_seq, fraction=0.5,
+                    timeoutms=5000):
+        """Admit a NEW storage shard by handing it a slot range from a
+        live ``source`` shard — the replay half of live autoscaling
+        (docs/autoscaling.md "Shard handoff").
+
+        The caller has already (1) checkpointed the source at
+        ``cut_seq`` (its ``save`` RPC) and (2) spawned ``new_shard``
+        restored FROM that checkpoint (:meth:`~blendjax.replay.service.
+        ShardFleet.grow` with ``restore_ckpt=``), so the new shard
+        holds every source row up to the cut.  This method verifies
+        that, copies only the rows the source appended PAST the cut
+        into the moving range (reconciled via ``written_since`` — the
+        same machinery re-admission trusts), and flips ownership of the
+        upper ``fraction`` of the source's slots under the buffer lock
+        (appends block for the cutover, draws never stop).
+
+        Total capacity, the SumTree and the RNG are untouched: draws
+        over unmoved ranges are bit-identical, draws over moved ranges
+        gather the same rows from a different process.
+
+        ABORTS WHOLE on any verification or copy failure
+        (:class:`ReshardAborted`, ``autoscale_reshard_aborts``): the
+        ownership map is untouched, the source keeps serving its full
+        range, and the caller retires the would-be shard.  The source
+        is never quarantined by a handoff failure — direct RPCs here
+        bypass the write-path quarantine machinery on purpose.
+
+        Params
+        ------
+        new_shard: str | ShardClient
+            Endpoint (or prepared client) of the restored new shard.
+        source: int
+            Live shard index surrendering a slot range.
+        cut_seq: int
+            The source's durability cursor at the checkpoint the new
+            shard restored (``save`` RPC's ``seq``).
+        fraction: float
+            Fraction of the source's owned slots to move (upper end of
+            its owned range; defaults to an even split).
+
+        Returns the new shard's index.
+        """
+        s = int(source)
+        cut_seq = int(cut_seq)
+        t0 = time.perf_counter()
+        if isinstance(new_shard, ShardClient):
+            client = new_shard
+            if client.spans is None:
+                client.spans = self.spans
+        else:
+            client = ShardClient(
+                new_shard, self.num_shards,
+                fault_policy=self.fault_policy, counters=self.counters,
+                timeoutms=timeoutms, span_recorder=self.spans,
+            )
+
+        def _abort(why, exc=None):
+            self.counters.incr("autoscale_reshard_aborts")
+            flight_recorder.note(
+                "autoscale_reshard_aborted", target=f"shard{s}",
+                reason=why, buffer=self.name,
+            )
+            client.reset_channel()
+            logger.error(
+                "%s: shard handoff from %d aborted (%s); ownership map "
+                "untouched, source keeps serving", self.name, s, why,
+            )
+            err = ReshardAborted(f"{self.name}: shard handoff aborted: {why}")
+            if exc is not None:
+                raise err from exc
+            raise err
+
+        # phase 1 (unlocked): verify the new shard restored the cut
+        try:
+            hello = client.rpc("hello")
+        except ShardRPCError as exc:
+            _abort(f"new shard unreachable: {exc}", exc)
+        if int(hello["capacity"]) != self.shard_capacity:
+            _abort(
+                f"new shard capacity {hello['capacity']} != "
+                f"{self.shard_capacity}"
+            )
+        if int(hello["seq"]) != cut_seq:
+            _abort(
+                f"new shard restored seq {hello['seq']}, expected the "
+                f"cut at {cut_seq} (wrong/stale checkpoint)"
+            )
+
+        # phase 2 (locked): appends block while ownership flips; draws
+        # keep flowing the moment the lock drops
+        with self._cond:
+            if s < 0 or s >= self.num_shards:
+                _abort(f"no such source shard {s}")
+            if self._dead[s]:
+                _abort(f"source shard {s} is quarantined")
+            owned = self._owned_slots(s)
+            k = int(len(owned) * float(fraction))
+            if k < 1 or k >= len(owned):
+                _abort(
+                    f"fraction {fraction} of {len(owned)} owned slots "
+                    "leaves nothing to move (or nothing behind)"
+                )
+            moved = owned[len(owned) - k:]
+            if self._pending[moved].any():
+                _abort("journaled rows in the moving range")
+            # rows the source appended past the cut: exactly these are
+            # missing from the checkpoint the new shard restored
+            try:
+                since = self.clients[s].rpc(
+                    "written_since", {"seq": cut_seq}
+                )
+            except ShardRPCError as exc:
+                _abort(f"source written_since failed: {exc}", exc)
+            if not since["complete"]:
+                _abort(
+                    "source cannot enumerate rows past the cut (tail "
+                    "rotated); re-checkpoint and retry"
+                )
+            inv = self._local_to_global(s)
+            moving = set(int(g) for g in moved)
+            delta = sorted({
+                int(slot) for slot in since["slots"]
+                if int(slot) in inv and inv[int(slot)] in moving
+            })
+            new_seq = cut_seq
+            if delta:
+                keys = list(self.store._schema or {})
+                if not keys:
+                    _abort(
+                        f"{len(delta)} rows past the cut but no schema "
+                        "fixed client-side (state mismatch)"
+                    )
+                try:
+                    got = self.clients[s].rpc(
+                        "gather", {"indices": delta, "keys": keys},
+                        raw_buffers=True,
+                    )
+                    rows = [
+                        {key: got["data"][key][i] for key in keys}
+                        for i in range(len(delta))
+                    ]
+                    reply = client.rpc(
+                        "append", {"rows": rows, "slots": delta},
+                        raw_buffers=True,
+                    )
+                except ShardRPCError as exc:
+                    _abort(f"delta copy failed: {exc}", exc)
+                new_seq = int(reply["seq"])
+            # commit: the new shard joins the draw domain owning the
+            # moved range; everything before this line was reversible
+            t = self.num_shards
+            client.shard_id = t
+            self.clients.append(client)
+            self.num_shards = t + 1
+            self._dead = np.append(self._dead, False)
+            self._acked.append(int(new_seq))
+            self._owner[moved] = t
+            self._cond.notify_all()
+        dt = time.perf_counter() - t0
+        self.timer.add("autoscale_handoff", dt, _t0=t0)
+        self.counters.incr("autoscale_reshard_handoffs")
+        self.counters.incr("autoscale_reshard_rows_copied", len(delta))
+        flight_recorder.note(
+            "autoscale_reshard_handoff", target=f"shard{t}",
+            source=s, moved=len(moved), copied=len(delta),
+            cut_seq=cut_seq, buffer=self.name,
+        )
+        logger.warning(
+            "%s: shard %d adopted %d slots from shard %d (%d rows "
+            "copied past the cut, %.3fs); draw stream continuous",
+            self.name, t, len(moved), s, len(delta), dt,
+        )
+        return t
 
     # -- observability -------------------------------------------------------
 
@@ -999,6 +1216,10 @@ class ShardedReplay(ReplayBuffer):
                 "acked": [int(a) for a in self._acked],
                 "journal_pending": int(self._pending.sum()),
                 "addresses": [c.address for c in self.clients],
+                "owned_slots": [
+                    int((self._owner == s).sum())
+                    for s in range(self.num_shards)
+                ],
             }
         return st
 

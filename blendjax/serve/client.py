@@ -25,6 +25,8 @@ resume — the recovery path the chaos tests exercise under
 from __future__ import annotations
 
 import logging
+import random
+import time
 
 import numpy as np
 
@@ -49,7 +51,8 @@ class ServeClient:
     def __init__(self, address, *, fault_policy=None, counters=None,
                  timeoutms=5000, context=None, span_recorder=None,
                  name="serve", model=None, shm="auto", shm_chaos=None,
-                 follow_redirects=True):
+                 follow_redirects=True, fallback_backoff_s=0.05,
+                 fallback_backoff_max_s=2.0):
         self.address = address
         #: the address this client was CONSTRUCTED with — against a
         #: sharded gateway that is the front, and the recovery anchor:
@@ -94,6 +97,15 @@ class ServeClient:
         self._shm_mode = shm
         self._shm_chaos = shm_chaos
         self._chan = None
+        #: front-fallback pacing: consecutive transport failures since
+        #: the last good reply.  Each failure that re-points at the
+        #: front first sleeps ``min(max, base * 2**(n-1))`` with
+        #: uniform jitter, so a worker-respawn window is not a tight
+        #: re-dial loop bursting load onto the relay front.  ``base=0``
+        #: disables the pause (latency-critical probes).
+        self._fallback_failures = 0
+        self._fallback_backoff_s = float(fallback_backoff_s)
+        self._fallback_backoff_max_s = float(fallback_backoff_max_s)
 
     def _channel(self):
         if self._chan is None:
@@ -171,15 +183,26 @@ class ServeClient:
                 # back to the front so the NEXT rpc re-resolves (the
                 # front answers, relays to a live worker, or names the
                 # stale lease) — the raised error already carries the
-                # dead worker's id in its text
+                # dead worker's id in its text.  The fall-back is
+                # PACED: bounded exponential backoff + jitter, so N
+                # clients losing the same worker (a respawn window) do
+                # not re-dial the front in a lockstep burst
+                self._fallback_failures += 1
+                delay = self._fallback_delay()
                 logger.warning(
                     "%s: gateway worker %s at %s unresponsive; falling "
-                    "back to the front at %s", self.name, self.gw_worker,
-                    self.address, self._front_address,
+                    "back to the front at %s (after %.3fs backoff)",
+                    self.name, self.gw_worker, self.address,
+                    self._front_address, delay,
                 )
+                if delay > 0:
+                    time.sleep(delay)
                 self._channel().redirect(self._front_address)
                 self.address = self._front_address
+            else:
+                self._fallback_failures += 1
             raise
+        self._fallback_failures = 0
         rep = reply.get("replica")
         if rep is not None:
             self.replica = rep
@@ -191,6 +214,17 @@ class ServeClient:
             self.weight_version = wv
         self._maybe_follow(reply)
         return reply
+
+    def _fallback_delay(self):
+        """The paced re-dial delay for the CURRENT consecutive-failure
+        count: ``min(cap, base * 2**(n-1))``, jittered to 50–100% so
+        concurrent clients de-correlate."""
+        if self._fallback_backoff_s <= 0 or self._fallback_failures <= 0:
+            return 0.0
+        raw = self._fallback_backoff_s * (
+            2.0 ** (self._fallback_failures - 1))
+        return min(self._fallback_backoff_max_s, raw) * random.uniform(
+            0.5, 1.0)
 
     def _maybe_follow(self, reply):
         """A sharded front's handoff: a reply naming both the worker

@@ -322,8 +322,21 @@ class ServeGateway:
                         else self.worker_index)
         self._reply_cache = OrderedDict()
         self._reply_cache_depth = int(reply_cache_depth)
-        #: watchdog notices (thread-safe appends), applied on the loop
+        #: watchdog + autoscale notices (thread-safe appends), applied
+        #: on the loop.  ``("add", rid, address)`` / ``("remove", rid,
+        #: None)`` are the live-resize ops: the DEALER socket is created
+        #: and registered ON the loop thread (zmq sockets are not
+        #: thread-safe), so ``add_replica``/``remove_replica`` stay
+        #: callable from any controller thread
         self._notices = deque()
+        #: next replica id the live-resize path allocates ("r<N>") —
+        #: monotonic so a retired id is never reused (stale leases and
+        #: in-flight routes on the old id can never alias a newcomer)
+        self._rid_seq = len(replicas)
+        self._rid_lock = threading.Lock()
+        #: the serve_forever poller, stored so _apply_notices can
+        #: register/unregister replica sockets added after loop start
+        self._poller = None
         #: front-side ShmRPC transport (clients upgrade onto it exactly
         #: as against a bare server) — its bell doubles as the shared
         #: reply-wake fd for the BACKEND shm channels, so one poller
@@ -365,15 +378,41 @@ class ServeGateway:
 
     def drain(self, rid):
         """Stop routing FRESH episodes to ``rid``; its live episodes
-        keep stepping until they close — the rolling-restart primitive."""
-        rep = self._replicas[rid]
-        if not rep.draining:
-            rep.draining = True
-            self.counters.incr("gateway_drains")
+        keep stepping until they close — the rolling-restart primitive.
+
+        Idempotent: re-draining an already-draining replica is a no-op
+        (returns ``False``, no second ``gateway_drains`` count), so a
+        restarted autoscale controller can re-issue its decision
+        against observed fleet state without double-acting.  Legal on a
+        QUARANTINED replica: the flag survives quarantine and
+        re-admission (``_ingest_scrape`` never touches ``draining``),
+        so a victim that dies mid-drain comes back still draining.
+        An unknown ``rid`` raises ``KeyError`` naming the known ids —
+        never a silent no-op."""
+        rep = self._replicas.get(rid)
+        if rep is None:
+            raise KeyError(
+                f"unknown replica {rid!r}; known: {self._order}"
+            )
+        if rep.draining:
+            return False
+        rep.draining = True
+        self.counters.incr("gateway_drains")
         return True
 
     def undrain(self, rid):
-        self._replicas[rid].draining = False
+        """Re-admit a drained replica to fresh-episode routing.  Same
+        contract as :meth:`drain`: idempotent (``False`` when it was
+        not draining), legal while quarantined, ``KeyError`` with the
+        known ids on an unknown ``rid``."""
+        rep = self._replicas.get(rid)
+        if rep is None:
+            raise KeyError(
+                f"unknown replica {rid!r}; known: {self._order}"
+            )
+        if not rep.draining:
+            return False
+        rep.draining = False
         return True
 
     def canary(self, version, fraction=0.25):
@@ -535,16 +574,102 @@ class ServeGateway:
         return (idx_or_rid if isinstance(idx_or_rid, str)
                 else f"r{int(idx_or_rid)}")
 
+    def add_replica(self, address, rid=None):
+        """Admit a NEW replica to the route set (autoscale scale-up).
+        Callable from any thread: allocates a never-reused id and
+        enqueues the admission; the loop thread creates and registers
+        the DEALER socket.  The newcomer is scraped immediately and
+        joins fresh-episode routing once it answers.  Returns the id."""
+        with self._rid_lock:
+            if rid is None:
+                rid = f"r{self._rid_seq}"
+                self._rid_seq += 1
+            else:
+                # an explicit id (fleet-index alignment) advances the
+                # sequence past it so later automatic ids cannot alias
+                num = rid[1:]
+                if rid.startswith("r") and num.isdigit():
+                    self._rid_seq = max(self._rid_seq, int(num) + 1)
+        self._notices.append(("add", rid, address))
+        return rid
+
+    def remove_replica(self, rid):
+        """Retire ``rid`` from the gateway entirely (autoscale
+        scale-down, after its drain reached zero live leases).  Any
+        lease still on it is marked dead — the owning client gets the
+        actionable stale-lease error, exactly the quarantine path —
+        so removal is safe even when the drain was cut short."""
+        self._notices.append(("remove", rid, None))
+
+    def replica_ids(self):
+        """The CURRENT route-set ids (admissions/removals applied on
+        the loop thread may lag an ``add_replica`` by one loop tick)."""
+        return list(self._order)
+
+    def lease_count(self, rid):
+        """Live (non-dead) leases owned by ``rid`` — what an autoscale
+        drain polls toward zero before retiring the process."""
+        return sum(1 for lease in list(self._leases.values())
+                   if lease.rid == rid and not lease.dead)
+
+    def replica_snapshots(self):
+        """Per-replica routing-state snapshots (healthy / draining /
+        load), the scrape surface controller decisions read."""
+        return {r.id: r.snapshot() for r in list(self._replicas.values())}
+
     def _apply_notices(self):
         while self._notices:
-            kind, rid = self._notices.popleft()
+            kind, rid, payload = (self._notices.popleft() + (None,))[:3]
+            if kind == "add":
+                self._admit_replica(rid, payload)
+                continue
             rep = self._replicas.get(rid)
             if rep is None:
                 continue
-            if kind == "death":
+            if kind == "remove":
+                self._retire_replica(rep)
+            elif kind == "death":
                 self._quarantine(rep)
             else:  # respawn: probe now
                 rep.next_scrape = 0.0
+
+    def _admit_replica(self, rid, address):
+        import zmq
+
+        if rid in self._replicas:
+            return  # idempotent against a re-enqueued admission
+        sock = self._ctx.socket(zmq.DEALER)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(address)
+        rep = _Replica(rid, address, sock, time.monotonic())
+        self._replicas[rid] = rep
+        self._order.append(rid)
+        if self._poller is not None:
+            self._poller.register(sock, zmq.POLLIN)
+        logger.info("gateway: replica %s (%s) admitted", rid, address)
+
+    def _retire_replica(self, rep):
+        self._demote_backend(rep, "replica retired")
+        for lease in self._leases.values():
+            if lease.rid == rep.id:
+                lease.dead = True
+        for mid in [m for m, r in self._scrapes.items() if r == rep.id]:
+            self._scrapes.pop(mid, None)
+        if self._poller is not None:
+            try:
+                self._poller.unregister(rep.sock)
+            except KeyError:
+                pass
+        try:
+            rep.sock.close(0)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        self._replicas.pop(rep.id, None)
+        if rep.id in self._order:
+            self._order.remove(rep.id)
+        self._rr = self._rr % max(1, len(self._order))
+        logger.info("gateway: replica %s (%s) retired", rep.id,
+                    rep.address)
 
     # -- lease + quarantine bookkeeping --------------------------------------
 
@@ -1438,6 +1563,9 @@ class ServeGateway:
             poller.register(self._shm_front.fd, zmq.POLLIN)
         for rep in self._replicas.values():
             poller.register(rep.sock, zmq.POLLIN)
+        # stored so live resize (_admit_replica/_retire_replica, loop
+        # thread only) can register/unregister replica sockets
+        self._poller = poller
         while stop_event is None or not stop_event.is_set():
             self._apply_notices()
             self._scrape_tick()
@@ -1446,7 +1574,7 @@ class ServeGateway:
                 if self._front in events:
                     self._drain_front()
                 self._drain_front_shm()
-                for rep in self._replicas.values():
+                for rep in list(self._replicas.values()):
                     if rep.sock in events:
                         self._drain_replica(rep)
                     self._drain_replica_shm(rep)

@@ -1587,6 +1587,10 @@ class ServerFleet:
             raise ValueError(
                 f"seeds has {len(seeds)} entries for {replicas} replicas"
             )
+        # kept for grow(): newcomers are spawned with the same config
+        # (and the shared seed, so they serve identical weights)
+        self._seed = seed
+        self._kwargs = dict(kwargs)
         self._procs = [
             ServerProcess(seed=(seeds[i] if seeds is not None else seed),
                           **kwargs)
@@ -1596,7 +1600,7 @@ class ServerFleet:
 
     @property
     def addresses(self):
-        return [p.address for p in self._procs]
+        return [None if p is None else p.address for p in self._procs]
 
     def __enter__(self):
         try:
@@ -1618,13 +1622,78 @@ class ServerFleet:
     def respawn(self, idx):
         """Relaunch replica ``idx`` with its original command line (the
         watchdog's contract)."""
+        if self._procs[idx] is None:
+            raise RuntimeError(
+                f"replica {idx} is retired; a retired slot is never "
+                "respawned (grow() to add capacity)"
+            )
         proc = self._procs[idx].respawn(0)
         self.launch_info.processes[idx] = proc
         return proc
 
+    def grow(self, n=1, *, seeds=None):
+        """Spawn ``n`` NEW replicas into the live fleet (autoscale
+        scale-up).  They are appended — existing fleet indices (and so
+        the gateway's ``r<idx>`` id alignment and any watchdog watching
+        ``launch_info``) never move.  Spawns overlap, then each
+        newcomer is waited ready.  Returns ``[(idx, address), ...]``
+        for the gateway admission."""
+        if self.launch_info is None:
+            raise RuntimeError("grow() needs an entered fleet")
+        if seeds is not None and len(seeds) != int(n):
+            raise ValueError(
+                f"seeds has {len(seeds)} entries for {n} new replicas"
+            )
+        added = []
+        for j in range(int(n)):
+            p = ServerProcess(
+                seed=(seeds[j] if seeds is not None else self._seed),
+                **self._kwargs,
+            )
+            self._procs.append(p)
+            idx = len(self._procs) - 1
+            p.launch_info = _ServeLaunchInfo([p._spawn()], [p.address])
+            self.launch_info.processes.append(
+                p.launch_info.processes[0])
+            self.launch_info.addresses["SERVE"].append(p.address)
+            added.append((idx, p.address))
+        try:
+            for idx, _ in added:
+                self._procs[idx].wait_ready(self._procs[idx].ready_timeout)
+        except BaseException:
+            # a newcomer that never came up is retired on the spot: the
+            # established fleet is untouched and indices stay stable
+            for idx, _ in added:
+                self.retire(idx)
+            raise
+        return added
+
+    def retire(self, idx):
+        """Retire replica ``idx`` permanently (autoscale scale-down,
+        AFTER its gateway drain reached zero leases): terminate the
+        process and sweep its ``/dev/shm``.  The index slot is kept
+        (``None``) so fleet indices stay aligned with gateway ids and
+        the watchdog skips it instead of respawning it.  Idempotent."""
+        p = self._procs[idx]
+        if p is None:
+            return False
+        # slot goes None BEFORE the kill: a watchdog polling between
+        # the two must see a retired slot, not a death to respawn
+        self._procs[idx] = None
+        if self.launch_info is not None:
+            self.launch_info.processes[idx] = None
+        p.close()
+        return True
+
+    def shrink(self, victims):
+        """Retire every index in ``victims``; returns those actually
+        retired (already-retired slots are skipped)."""
+        return [idx for idx in victims if self.retire(idx)]
+
     def close(self):
         for p in self._procs:
-            p.close()
+            if p is not None:
+                p.close()
         self.launch_info = None
 
     def __exit__(self, *exc):

@@ -81,6 +81,12 @@ FLEET_EVENTS = (
     # its fixed capacity was reached (the recording is truncated, not the
     # stream; see btt/file.py)
     "record_drops",
+    # watchdog respawn pacing: ``watchdog_backoff_jitter_ms`` — total
+    # milliseconds of per-member randomized delay FleetWatchdog inserted
+    # before respawns, so N members killed together do not relaunch in
+    # lockstep and stampede the gateway's re-admission scrape (see
+    # docs/fault_tolerance.md; the jitter itself is `respawn_jitter_s`)
+    "watchdog_backoff_jitter_ms",
 )
 
 #: Canonical experience-replay event names (see docs/replay.md).  Same
@@ -326,6 +332,68 @@ HA_EVENTS = (
     "ha_ckpt_failures", "ha_ckpt_evicted",
     "ha_restores", "ha_restore_fallbacks",
     "ha_learner_deaths", "ha_learner_respawns", "ha_resume_publishes",
+)
+
+#: Canonical autoscale control-plane event names (see
+#: docs/autoscaling.md).  Same contract as ``FLEET_EVENTS``: any
+#: ``EventCounters`` accepts them and the TelemetryHub zero-fills every
+#: name in every scrape.
+#: ``autoscale_ticks`` — controller decision passes executed;
+#: ``autoscale_holds`` — decision passes that wanted to act but were
+#: suppressed by a per-direction cooldown, the hysteresis band, the
+#: min/max fleet bounds, or a transition already in flight (the
+#: single-transition-at-a-time rule);
+#: ``autoscale_scale_ups`` — serve scale-ups COMMITTED: a new replica
+#: spawned, admitted at the gateway, and survived its post-action
+#: healthy window;
+#: ``autoscale_scale_downs`` — serve scale-downs committed: a replica
+#: drained to zero leases, the shrunk fleet survived the healthy
+#: window, and the process was retired and its ``/dev/shm`` swept;
+#: ``autoscale_rollbacks`` — transitions ROLLED BACK by the verifier
+#: (error-rate or p99 regression in the healthy window): the draining
+#: replica was re-admitted, or the freshly-added replica was drained
+#: back out — capacity returns to the pre-decision state;
+#: ``autoscale_drain_timeouts`` — scale-downs abandoned because live
+#: leases did not finish or idle out inside the bounded drain grace
+#: window (the victim is undrained; counted under rollbacks too);
+#: ``autoscale_replica_spawns`` — replica processes spawned by the
+#: controller (before verification — a rolled-back spawn still counts);
+#: ``autoscale_replicas_retired`` — replica processes retired (drained,
+#: verified, terminated, shm swept);
+#: ``autoscale_adoptions`` — in-flight transitions a (re)started
+#: controller ADOPTED from observed fleet state instead of acting anew
+#: (a replica already draining, an un-verified extra replica): the
+#: idempotence witness for the SIGKILL-the-controller drill;
+#: ``autoscale_reshard_handoffs`` — replay shard handoffs COMMITTED
+#: (source checkpoint restored by the new shard, ``written_since``
+#: reconciled, client slot-range map cut over);
+#: ``autoscale_reshard_aborts`` — handoffs aborted whole (new shard
+#: died / checkpoint or seq mismatch / reconcile overflow): the client
+#: map is untouched and the source shard keeps serving its range;
+#: ``autoscale_reshard_rows_copied`` — rows copied source→new shard
+#: during handoffs (checkpoint restore is not counted; this is the
+#: ``written_since`` reconcile traffic).
+AUTOSCALE_EVENTS = (
+    "autoscale_ticks", "autoscale_holds",
+    "autoscale_scale_ups", "autoscale_scale_downs",
+    "autoscale_rollbacks", "autoscale_drain_timeouts",
+    "autoscale_replica_spawns", "autoscale_replicas_retired",
+    "autoscale_adoptions",
+    "autoscale_reshard_handoffs", "autoscale_reshard_aborts",
+    "autoscale_reshard_rows_copied",
+)
+
+#: Canonical autoscale stage names (see docs/autoscaling.md):
+#: ``autoscale_tick`` (one decision pass: scrape-derived load fold +
+#: rule evaluation), ``autoscale_resize`` (decision → fleet healthy at
+#: the new size, the whole transition including drain/verify — the
+#: ``resize_settle_s`` bench metric is this stage's observation),
+#: ``autoscale_drain`` (drain issued → victim's live leases at zero),
+#: ``autoscale_handoff`` (shard handoff: source checkpoint → client
+#: map cutover).
+AUTOSCALE_STAGES = (
+    "autoscale_tick", "autoscale_resize", "autoscale_drain",
+    "autoscale_handoff",
 )
 
 #: Canonical learner-failover stage names (see docs/fault_tolerance.md

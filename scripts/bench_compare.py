@@ -99,6 +99,12 @@ DEFAULT_CEILINGS = {
     # the slack is wide — the guard catches a recovery-path regression
     # (e.g. an accidental full-buffer rewrite at restore), not noise
     "learner_recovery_s": 1.50,
+    # autoscale decision -> verified-healthy commit at the new fleet
+    # size: seconds, dominated by the replica spawn and the configured
+    # healthy window, so the slack is wide — the guard catches a
+    # settle-path regression (a stuck drain, a window that never
+    # closes), not window-length noise (docs/autoscaling.md)
+    "resize_settle_s": 1.50,
 }
 
 #: fallback floor for numeric metrics named via --metrics that have no
@@ -176,6 +182,15 @@ def _flatten(doc, metrics):
             if isinstance(sc.get(k), (int, float)) \
                     and not isinstance(sc.get(k), bool):
                 metrics[k] = float(sc[k])
+    ab = doc.get("autoscale_bench")
+    if isinstance(ab, dict):
+        # drain_error_x is deliberately NOT trajectory-guarded here:
+        # its contract is an absolute zero (0/0 has no ratio), asserted
+        # by the bench itself and tests/test_autoscale.py
+        for k in ("resize_settle_s",):
+            if isinstance(ab.get(k), (int, float)) \
+                    and not isinstance(ab.get(k), bool):
+                metrics[k] = float(ab[k])
 
 
 def _regex_salvage(text, metrics):

@@ -34,6 +34,8 @@ from blendjax.obs.spans import (
     span_trace,
 )
 from blendjax.utils.timing import (
+    AUTOSCALE_EVENTS,
+    AUTOSCALE_STAGES,
     FEED_STAGES,
     FLEET_EVENTS,
     GATEWAY_EVENTS,
@@ -215,11 +217,11 @@ def test_scrape_zero_fill_contract():
     snap = hub.scrape()
     for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS \
             + GATEWAY_EVENTS + WEIGHT_EVENTS + SCENARIO_EVENTS \
-            + HA_EVENTS:
+            + HA_EVENTS + AUTOSCALE_EVENTS:
         assert snap["counters"][name] == 0, name
     for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES \
             + GATEWAY_STAGES + WEIGHT_STAGES + SCENARIO_STAGES \
-            + HA_STAGES:
+            + HA_STAGES + AUTOSCALE_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
@@ -230,6 +232,7 @@ def test_scrape_zero_fill_contract():
     assert 'blendjax_events_total{event="weight_adopted"} 0' in prom
     assert 'blendjax_events_total{event="scenario_pushes"} 0' in prom
     assert 'blendjax_events_total{event="ha_ckpt_saves"} 0' in prom
+    assert 'blendjax_events_total{event="autoscale_ticks"} 0' in prom
     assert ('blendjax_stage_latency_seconds{stage="weight_swap",'
             'quantile="0.99"} 0') in prom
     assert ('blendjax_stage_latency_seconds{stage="scenario_push",'
@@ -841,6 +844,34 @@ def test_documented_ha_stages_exist_in_tuples():
         "## HA stage vocabulary",
     )
     vocab = set(HA_STAGES)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_autoscale_counters_exist_in_tuples():
+    """The autoscale vocabulary lock (ISSUE-18 tentpole): every
+    ``AUTOSCALE_EVENTS`` counter docs/autoscaling.md tabulates exists
+    in the tuple and every tuple name is tabulated — both directions,
+    same contract as the other vocabularies."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "autoscaling.md"),
+        "## Counter vocabulary",
+    )
+    vocab = set(AUTOSCALE_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_autoscale_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "autoscaling.md"),
+        "## Stage vocabulary",
+    )
+    vocab = set(AUTOSCALE_STAGES)
     missing = [n for n in names if n not in vocab]
     assert not missing, f"documented but not in tuples: {missing}"
     absent = [n for n in vocab if n not in set(names)]
