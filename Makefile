@@ -10,10 +10,10 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test tier1 chaos chaos-replay chaos-learner chaos-autoscale \
-	blender-tests \
+	chaos-pipeline blender-tests \
 	tpu-tests bench rlbench rlbench-sharded replaybench shmbench \
 	servebench gatewaybench weightbench scenariobench habench \
-	autoscalebench multichip dryrun benchdiff obsdemo
+	autoscalebench pipebench multichip dryrun benchdiff obsdemo
 
 test:
 	# env -u: the axon sitecustomize trigger makes `import jax` dial the
@@ -86,6 +86,19 @@ chaos-autoscale:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		BJX_POSTMORTEM_DIR=obs_artifacts \
 		$(PYTHON) -m pytest tests/test_autoscale.py -m chaos -q -rs
+
+# The MPMD pipeline chaos pack (tests/test_mpmd.py): SIGKILL one stage
+# process mid-training -> FleetWatchdog respawn -> the stage restores
+# its params from the per-stage checkpoint cut, the driver reconciles
+# every stage to the lowest applied update and replays the in-flight
+# one — same-mid resends deduped by the reply cache, so no microbatch
+# is lost or applied twice and the final params match an uninterrupted
+# run exactly.  Subset of `make chaos` (same marker).  See
+# docs/pipeline.md.
+chaos-pipeline:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		BJX_POSTMORTEM_DIR=obs_artifacts \
+		$(PYTHON) -m pytest tests/test_mpmd.py -m chaos -q -rs
 
 # Real-Blender acceptance subset (camera goldens, producer streaming,
 # cartpole physics).  Skips cleanly when no Blender is discoverable.
@@ -247,6 +260,14 @@ habench:
 autoscalebench:
 	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
 		$(PYTHON) benchmarks/autoscale_benchmark.py
+
+# MPMD pipeline microbench (docs/pipeline.md): N-stage stage-process
+# pipeline vs a 1-stage same-harness baseline in interleaved windows;
+# the `pipe_mpmd_x` throughput ratio is carried into the bench headline
+# (bench_compare floors it).
+pipebench:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+		$(PYTHON) benchmarks/pipeline_benchmark.py
 
 # Bench-trajectory guardrail (docs/observability.md): diff two bench
 # artifacts with per-metric regression floors; non-zero exit on any

@@ -482,13 +482,31 @@ def main():
         )
         autoscale_bench = as_lines[-1] if as_lines else None
 
+    # eleventh configuration: the MPMD pipeline-parallel learner
+    # (docs/pipeline.md) — N stage processes with 1F1B microbatch
+    # interleaving vs a 1-stage same-harness baseline, interleaved
+    # windows, calibrated per-stage compute stand-in.
+    pipeline_bench = None
+    remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start) - 20
+    if remaining > 40:
+        pb_lines = run_child_collect_json(
+            [
+                sys.executable,
+                os.path.join(HERE, "benchmarks", "pipeline_benchmark.py"),
+            ],
+            rl_env,
+            min(150, remaining),
+        )
+        pipeline_bench = pb_lines[-1] if pb_lines else None
+
     out = assemble(phases, rl, rl_physics, host_fallback=host_only_fallback,
                    feed_bound=feed_bound, rl_pipelined=rl_pipelined,
                    replay_bench=replay_bench, rl_sharded=rl_sharded,
                    serve_bench=serve_bench, gateway_bench=gateway_bench,
                    weight_bench=weight_bench,
                    scenario_bench=scenario_bench, ha_bench=ha_bench,
-                   autoscale_bench=autoscale_bench)
+                   autoscale_bench=autoscale_bench,
+                   pipeline_bench=pipeline_bench)
     if out.get("device") != "tpu":
         probes = probe_log_summary()
         if probes:
@@ -532,6 +550,7 @@ HEADLINE_ABBREV = (
 HEADLINE_BYTE_BUDGET = 400
 HEADLINE_TRIM_ORDER = (
     ("telemetry_overhead_x",),
+    ("pipe_mpmd_x",),
     ("resize_settle_s", "drain_error_x"),
     ("ckpt_overhead_x", "learner_recovery_s"),
     ("scenario_hetero_x", "serve_mix_p99_ms"),
@@ -656,6 +675,11 @@ def headline(out):
             line["resize_settle_s"] = asb["resize_settle_s"]
         if asb.get("drain_error_x") is not None:
             line["drain_error_x"] = asb["drain_error_x"]
+    pb = out.get("pipeline_bench")
+    if pb and pb.get("pipe_mpmd_x") is not None:
+        # the MPMD pipeline headline: N stage processes' 1F1B schedule
+        # over the 1-stage same-harness baseline (floor 1.5 at 3 stages)
+        line["pipe_mpmd_x"] = pb["pipe_mpmd_x"]
     fv = out.get("fence_validation")
     if fv:
         ok = fv.get("fence_ok")
@@ -710,7 +734,7 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
              feed_bound=None, rl_pipelined=None, replay_bench=None,
              rl_sharded=None, serve_bench=None, gateway_bench=None,
              weight_bench=None, scenario_bench=None, ha_bench=None,
-             autoscale_bench=None):
+             autoscale_bench=None, pipeline_bench=None):
     """Assemble the driver's single JSON object from whatever phase lines
     arrived.  Pure (given ``host_fallback``), so the carry-through of
     stages/windows/canary/fence evidence is unit-testable
@@ -793,6 +817,22 @@ def assemble(phases, rl=None, rl_physics=None, host_fallback=None,
                 "autoscale_counters", "stages",
             )
             if k in autoscale_bench
+        }
+    if pipeline_bench \
+            and pipeline_bench.get("phase") == "pipeline_bench":
+        # the MPMD pipeline record: N-stage 1F1B over the 1-stage
+        # same-harness baseline in interleaved windows — see
+        # benchmarks/pipeline_benchmark.py
+        extras["pipeline_bench"] = {
+            k: pipeline_bench[k]
+            for k in (
+                "pipe_stages", "layers", "microbatches", "batch",
+                "wire", "work_us", "rounds", "window_updates",
+                "mpmd_updates_per_sec", "single_updates_per_sec",
+                "pipe_mpmd_x", "pair_ratios", "pipe_counters",
+                "stages",
+            )
+            if k in pipeline_bench
         }
     if weight_bench and weight_bench.get("phase") == "weight_bench":
         # the live-rollout cost record: publish -> first-serving-reply

@@ -205,6 +205,21 @@ AUTOSCALE_BENCH_KEYS = (
     "stages",            # autoscale_resize / autoscale_drain summaries
 )
 
+#: pipeline_benchmark.py emits exactly these (phase ``pipeline_bench``).
+#: ``pipe_mpmd_x`` — median interleaved-window throughput ratio of the
+#: N-stage MPMD arm over the 1-stage same-harness baseline (the
+#: headline number; bench_compare floors it); ``pipe_stages`` is the
+#: MPMD arm's stage-process count (the key "stages" means StageTimer
+#: summaries suite-wide, so the count rides its own name).
+PIPE_BENCH_KEYS = (
+    "pipe_stages", "layers", "microbatches", "batch", "wire",
+    "work_us", "rounds", "window_updates",
+    "mpmd_updates_per_sec", "single_updates_per_sec",
+    "pipe_mpmd_x", "pair_ratios",
+    "pipe_counters",
+    "stages",            # pipe_feed / pipe_finish driver summaries
+)
+
 
 def note(msg, who="suite"):
     print(f"[{who}] {msg}", file=sys.stderr, flush=True)

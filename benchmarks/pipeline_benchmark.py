@@ -1,0 +1,149 @@
+"""MPMD pipeline microbench (docs/pipeline.md): what stage-process
+parallelism buys the learner's update loop.
+
+Two arms, SAME harness (stage processes + the MpmdTrain driver, wire
+and all), alternated in interleaved windows so host drift cancels:
+
+- ``mpmd``   — N stage processes, the model's layers split across them,
+  microbatches interleaved 1F1B;
+- ``single`` — ONE stage process owning every layer (the degenerate
+  pipeline), same total compute per update.
+
+Per-layer compute is a calibrated stand-in (``--work-us`` of sleep per
+owned layer unit per direction — forward once, backward twice), so the
+ratio measures the SCHEDULE (overlap minus bubble, wire and protocol
+overheads included) rather than this host's BLAS.  The headline ratio::
+
+    pipe_mpmd_x = median over rounds of
+                  (mpmd updates/s) / (single updates/s)
+
+At N=3 stages the steady-state bound is ~2.7x (the busiest stage — the
+last, with its fused fwd+loss+bwd unit — owns ~1/N of the per-update
+work); the acceptance floor is 1.5 with the 1F1B bubble and wire tax
+paid.  One JSON line (phase ``pipeline_bench``; keys locked by
+``benchmarks/_common.PIPE_BENCH_KEYS``), carried into the ``bench.py``
+headline.  Run via ``make pipebench``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+if os.path.dirname(HERE) not in sys.path:
+    sys.path.insert(0, os.path.dirname(HERE))
+
+import numpy as np  # noqa: E402
+
+from benchmarks._common import note  # noqa: E402
+
+
+def _spec(args, n_procs):
+    return dict(
+        family="mse", d_in=args.d_in, wire=args.wire, d_out=args.d_out,
+        n_layers=args.layers, n_procs=n_procs, lr=1e-3, seed=0,
+    )
+
+
+def _window(driver, x, y, m, updates):
+    t0 = time.perf_counter()
+    for _ in range(updates):
+        driver.update(x, y, m)
+    dt = time.perf_counter() - t0
+    return updates / dt
+
+
+def measure(args):
+    from blendjax.parallel.mpmd import MpmdTrain, StageFleet
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(args.batch, args.d_in)).astype(np.float32)
+    y = rng.normal(size=(args.batch, args.d_out)).astype(np.float32)
+
+    out = {"pair_ratios": [], "mpmd_updates_per_sec": [],
+           "single_updates_per_sec": []}
+    note(f"launching {args.pipe_stages}-stage + 1-stage fleets "
+         f"(layers={args.layers} work_us={args.work_us})", "pipebench")
+    with StageFleet(_spec(args, args.pipe_stages),
+                    work_us=args.work_us) as mf, \
+            StageFleet(_spec(args, 1), work_us=args.work_us) as sf:
+        md = MpmdTrain(mf.addresses, _spec(args, args.pipe_stages))
+        sd = MpmdTrain(sf.addresses, _spec(args, 1))
+        try:
+            md.hello_all(timeout_s=120)
+            sd.hello_all(timeout_s=120)
+            # warmup: trace/jit every stage's compute units off the clock
+            _window(md, x, y, args.microbatches, 1)
+            _window(sd, x, y, args.microbatches, 1)
+            for r in range(args.rounds):
+                ups_m = _window(md, x, y, args.microbatches,
+                                args.window_updates)
+                ups_s = _window(sd, x, y, args.microbatches,
+                                args.window_updates)
+                out["mpmd_updates_per_sec"].append(round(ups_m, 3))
+                out["single_updates_per_sec"].append(round(ups_s, 3))
+                out["pair_ratios"].append(round(ups_m / ups_s, 3))
+                note(f"round {r}: mpmd {ups_m:.2f}/s single "
+                     f"{ups_s:.2f}/s ratio {ups_m / ups_s:.2f}",
+                     "pipebench")
+            out["pipe_mpmd_x"] = round(
+                statistics.median(out["pair_ratios"]), 3
+            )
+            out["pipe_counters"] = {
+                k: md.counters.get(k) for k in (
+                    "pipe_updates", "pipe_microbatches",
+                    "pipe_feed_parks", "pipe_resends", "pipe_restarts",
+                )
+            }
+            out["stages"] = md.timer.summary()
+        finally:
+            md.close()
+            sd.close()
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pipe-stages", type=int, default=3)
+    ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--d-in", type=int, default=32)
+    ap.add_argument("--wire", type=int, default=64)
+    ap.add_argument("--d-out", type=int, default=8)
+    ap.add_argument("--work-us", type=int, default=1500,
+                    help="per-layer-unit compute stand-in (us of sleep "
+                         "per direction)")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--window-updates", type=int, default=6)
+    args = ap.parse_args(argv)
+
+    out = {
+        "phase": "pipeline_bench",
+        "pipe_stages": args.pipe_stages,
+        "layers": args.layers,
+        "microbatches": args.microbatches,
+        "batch": args.batch,
+        "wire": args.wire,
+        "work_us": args.work_us,
+        "rounds": args.rounds,
+        "window_updates": args.window_updates,
+        "mpmd_updates_per_sec": None,
+        "single_updates_per_sec": None,
+        "pipe_mpmd_x": None,
+        "pair_ratios": None,
+        "pipe_counters": None,
+        "stages": None,
+    }
+    out.update(measure(args))
+    print(json.dumps(out), flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    main()

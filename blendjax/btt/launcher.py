@@ -214,6 +214,30 @@ class BlenderLauncher:
             logger.debug("swept %d shm objects under %s",
                          len(removed), self._shm_base)
 
+    def _unlink_instance_shm(self, idx):
+        """Sweep ONE instance's shm objects (its rings and any side
+        objects named under their prefixes) — the per-instance half of
+        the ``unlink_base`` hygiene, for the paths where one process is
+        replaced or removed while the launch lives on.  A SIGKILLed
+        producer never runs its own unlink; a live reader of a swept
+        ring sees the vanish and reopens the respawn's fresh
+        generation (``reconnects``), so sweeping before respawn is
+        safe."""
+        if self.proto != "shm" or self.launch_info is None:
+            return
+        from blendjax.btt.shm_rpc import unlink_base
+
+        for name, addrs in self.launch_info.addresses.items():
+            addr = addrs[idx]
+            if not addr.startswith("shm://"):
+                continue
+            removed = unlink_base(addr[len("shm://"):])
+            if removed:
+                logger.debug(
+                    "swept %d shm objects of instance %d socket %s",
+                    len(removed), idx, name,
+                )
+
     # -- lifecycle ----------------------------------------------------------
 
     def __enter__(self):
@@ -277,6 +301,10 @@ class BlenderLauncher:
                 f"instance {idx} is retired; a retired slot is never "
                 "respawned"
             )
+        # the dead incarnation ran no cleanup (SIGKILL): sweep its shm
+        # objects BEFORE the respawn recreates them, or every crash
+        # strands stale ring generations in /dev/shm
+        self._unlink_instance_shm(idx)
         new = subprocess.Popen(
             info.commands[idx],
             shell=False,
@@ -302,6 +330,7 @@ class BlenderLauncher:
             return False
         self._stop_process(p)
         info.processes[idx] = None
+        self._unlink_instance_shm(idx)
         logger.info("Retired instance %d", idx)
         return True
 

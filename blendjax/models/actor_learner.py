@@ -170,7 +170,8 @@ class ActorLearner:
                  replay=None, replay_ratio=0, replay_batch=64, hub=None,
                  weight_bus=None, publish_every=1,
                  scenarios=None, curriculum=None, fanin_min_ready=None,
-                 checkpointer=None):
+                 checkpointer=None, pipeline_stages=None,
+                 pipeline_microbatches=None):
         self.pools = _as_pools(pool)
         if num_fleets is not None:
             if self.pools and num_fleets != len(self.pools):
@@ -298,6 +299,49 @@ class ActorLearner:
             if replay is not None
             else None
         )
+        #: MPMD pipeline-parallel learner mode (docs/pipeline.md): the
+        #: off-policy update runs on an N-stage process fleet through a
+        #: :class:`~blendjax.parallel.mpmd.MpmdTrain` driver instead of
+        #: the in-process ``_replay_step``.  The driver's ``pg`` family
+        #: computes THE SAME importance-weighted loss — advantage
+        #: batch-normalized over the FULL batch host-side, so equal
+        #: microbatch means average to ``replay_loss_fn`` exactly (the
+        #: mpmd numerics tests lock it against ``make_pipeline_train``).
+        self.pipeline_driver = pipeline_stages
+        self.pipeline_microbatches = pipeline_microbatches
+        if pipeline_stages is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "pipeline_stages= and mesh= are different parallel "
+                    "axes of the learner; pass one"
+                )
+            if pipeline_stages.spec["family"] != "pg":
+                raise ValueError(
+                    "pipeline_stages= needs an MpmdTrain with "
+                    "family='pg' (the learner's off-policy loss); got "
+                    f"{pipeline_stages.spec['family']!r}"
+                )
+            if continuous:
+                raise ValueError(
+                    "pipeline_stages= supports discrete policies only "
+                    "(the pg stage loss is categorical)"
+                )
+            if (pipeline_stages.spec["d_in"], pipeline_stages.spec["d_out"]) \
+                    != (obs_dim, num_actions):
+                raise ValueError(
+                    f"pipeline spec d_in/d_out "
+                    f"({pipeline_stages.spec['d_in']}, "
+                    f"{pipeline_stages.spec['d_out']}) != learner "
+                    f"obs_dim/num_actions ({obs_dim}, {num_actions})"
+                )
+            if self.pipeline_microbatches is None:
+                self.pipeline_microbatches = \
+                    pipeline_stages.spec["n_procs"]
+            # the stage fleet owns the authoritative params (restored
+            # from its own checkpoints across respawns): adopt them, so
+            # actor sampling / bus publishes / checkpoints mirror the
+            # fleet instead of forking a second lineage from `seed`
+            self._adopt_pipeline_params()
         self.weight_bus = weight_bus
         self.publish_every = max(1, int(publish_every))
         #: last version id this learner published on the bus (None
@@ -668,12 +712,50 @@ class ActorLearner:
 
     # -- learner side --------------------------------------------------------
 
+    def _adopt_pipeline_params(self):
+        """Mirror the stage fleet's assembled params into the learner's
+        TrainState (and the actors' sampling snapshot)."""
+        params = jax.tree.map(
+            jnp.asarray, self.pipeline_driver.gather_params()
+        )
+        self.state = self.state._replace(
+            params=params, step=self.pipeline_driver.updates_done,
+        )
+        self._actor_params = params
+
+    def _pipeline_replay_update(self, obs, action, reward, is_weight):
+        """One off-policy update through the MPMD stage fleet: the
+        advantage is batch-normalized HERE over the full batch (so the
+        per-microbatch loss means average to ``replay_loss_fn``), the
+        microbatched records stream through the pipeline, and the
+        committed params come back as the new actor/bus/checkpoint
+        mirror."""
+        r = np.asarray(reward, np.float64)
+        adv = ((r - r.mean()) / (r.std() + 1e-6)).astype(np.float32)
+        loss = self.pipeline_driver.update(
+            np.asarray(obs, np.float32),
+            {
+                "action": np.asarray(action),
+                "adv": adv,
+                "w": np.asarray(is_weight, np.float32),
+            },
+            self.pipeline_microbatches,
+        )
+        self._adopt_pipeline_params()
+        return loss
+
     def _replay_step_and_refresh(self, batch, idx, reward):
         """The shared off-policy post-draw block (online tail AND
         run_offline): one sampled update, actor params mirror, and the
         sampled rows' priorities refreshed from |advantage| under the
         batch baseline (the same signal the loss weights)."""
-        self.state, loss = self._replay_step(self.state, batch)
+        if self.pipeline_driver is not None:
+            loss = self._pipeline_replay_update(
+                batch["obs"], batch["action"], batch["reward"],
+                batch["is_weight"],
+            )
+        else:
+            self.state, loss = self._replay_step(self.state, batch)
         self._publish_params()
         r = np.asarray(reward, np.float64)
         self.replay.update_priorities(idx, np.abs(r - r.mean()))
@@ -752,6 +834,41 @@ class ActorLearner:
         )
         losses = []
         t0 = time.perf_counter()
+        if self.pipeline_driver is not None:
+            # MPMD pipeline mode: stage 0 consumes the sampler's arena
+            # batches DIRECTLY — no device staging hop; the pipeline
+            # itself is the device.  The driver's bounded in-flight
+            # window composes with the bounded ArenaPool as
+            # backpressure: a full pipeline parks the feed
+            # (``pipe_feed_parks``), the parked feed keeps the arena
+            # buffer checked out, and the sampler blocks on ``acquire``
+            # instead of allocating.  Each buffer recycles the moment
+            # the update round has fully left it — the same
+            # recycle-after-transfer contract ``device_prefetch`` keeps.
+            try:
+                for ab in gen:
+                    data = ab.data
+                    try:
+                        losses.append(self._replay_step_and_refresh(
+                            data,
+                            np.asarray(data["replay_idx"]),
+                            np.asarray(data["reward"]),
+                        ))
+                    finally:
+                        ab.recycle()
+                    if len(losses) >= num_updates:
+                        break
+            finally:
+                stop.set()
+                gen.close()
+            elapsed = time.perf_counter() - t0
+            return {
+                "updates": len(losses),
+                "updates_per_sec": round(len(losses) / elapsed, 2),
+                "losses": losses,
+                "replay": self.replay.stats(),
+                "elapsed_s": round(elapsed, 3),
+            }
         it = device_prefetch(
             gen, size=prefetch, sharding=self._batch_sharding,
             timer=self.replay.timer,

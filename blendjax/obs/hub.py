@@ -9,10 +9,11 @@ snapshot:
 - :meth:`TelemetryHub.scrape` — a JSON-able dict with every canonical
   counter (``FLEET_EVENTS`` + ``REPLAY_EVENTS`` + ``SERVE_EVENTS`` +
   ``GATEWAY_EVENTS`` + ``WEIGHT_EVENTS`` + ``SCENARIO_EVENTS`` +
-  ``HA_EVENTS`` + ``AUTOSCALE_EVENTS``) and
+  ``HA_EVENTS`` + ``AUTOSCALE_EVENTS`` + ``PIPE_EVENTS``) and
   every canonical stage (``FEED_STAGES`` + ``REPLAY_STAGES`` +
   ``SERVE_STAGES`` + ``GATEWAY_STAGES`` + ``WEIGHT_STAGES`` +
-  ``SCENARIO_STAGES`` + ``HA_STAGES`` + ``AUTOSCALE_STAGES``)
+  ``SCENARIO_STAGES`` + ``HA_STAGES`` + ``AUTOSCALE_STAGES`` +
+  ``PIPE_STAGES``)
   **zero-filled** (the same
   contract ``FleetSupervisor.health()`` keeps: dashboards and tests
   need no existence checks), histograms merged across components so the
@@ -54,7 +55,8 @@ def _canonical_counters():
     return (timing.FLEET_EVENTS + timing.REPLAY_EVENTS
             + timing.SERVE_EVENTS + timing.GATEWAY_EVENTS
             + timing.WEIGHT_EVENTS + timing.SCENARIO_EVENTS
-            + timing.HA_EVENTS + timing.AUTOSCALE_EVENTS)
+            + timing.HA_EVENTS + timing.AUTOSCALE_EVENTS
+            + timing.PIPE_EVENTS)
 
 
 def _canonical_stages():
@@ -63,7 +65,8 @@ def _canonical_stages():
     return (timing.FEED_STAGES + timing.REPLAY_STAGES
             + timing.SERVE_STAGES + timing.GATEWAY_STAGES
             + timing.WEIGHT_STAGES + timing.SCENARIO_STAGES
-            + timing.HA_STAGES + timing.AUTOSCALE_STAGES)
+            + timing.HA_STAGES + timing.AUTOSCALE_STAGES
+            + timing.PIPE_STAGES)
 
 
 def _zero_stage():

@@ -378,13 +378,25 @@ def make_pipeline_train(stage_fn, loss_fn, mesh, pipe_axis="pipe",
 
 
 def microbatch(batch, num_microbatches):
-    """Host/device-side reshape (B, ...) -> (M, B/M, ...) for the pipeline."""
+    """Host/device-side reshape (B, ...) -> (M, B/M, ...) for the pipeline.
+
+    Every leaf's leading axis must split evenly — a ragged split would
+    silently change the per-microbatch loss weighting, so it raises the
+    same actionable shape error :func:`blendjax.btt.prefetch.put_batch`
+    uses, naming the offending leaf."""
+    m = int(num_microbatches)
+    if m < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
+
     def split(x):
         b = x.shape[0]
-        if b % num_microbatches:
+        if b % m:
             raise ValueError(
-                f"batch {b} not divisible by {num_microbatches} microbatches"
+                f"batch leaf of shape {tuple(x.shape)} not splittable into "
+                f"{m} microbatches: leading axis {b} leaves remainder "
+                f"{b % m}; pick batch/num_microbatches divisible "
+                f"(e.g. batch {b - b % m} or {b + m - b % m})"
             )
-        return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+        return x.reshape((m, b // m) + x.shape[1:])
 
     return jax.tree.map(split, batch)

@@ -78,6 +78,11 @@ DEFAULT_FLOORS = {
     # loop: throughput with the TrainCheckpointer attached over
     # checkpointing off (docs/fault_tolerance.md "Learner failover")
     "ckpt_overhead_x": 0.90,
+    # MPMD pipeline: N stage processes' 1F1B schedule over the 1-stage
+    # same-harness baseline at the calibrated compute stand-in — the
+    # whole claim of the stage-process tier (docs/pipeline.md), so it
+    # gets the tighter shard-style floor
+    "pipe_mpmd_x": 0.85,
 }
 
 #: metric -> maximum acceptable new/old ratio for LOWER-is-better
@@ -191,6 +196,11 @@ def _flatten(doc, metrics):
             if isinstance(ab.get(k), (int, float)) \
                     and not isinstance(ab.get(k), bool):
                 metrics[k] = float(ab[k])
+    pb = doc.get("pipeline_bench")
+    if isinstance(pb, dict):
+        if isinstance(pb.get("pipe_mpmd_x"), (int, float)) \
+                and not isinstance(pb.get("pipe_mpmd_x"), bool):
+            metrics["pipe_mpmd_x"] = float(pb["pipe_mpmd_x"])
 
 
 def _regex_salvage(text, metrics):

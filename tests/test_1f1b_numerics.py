@@ -1,0 +1,141 @@
+"""1F1B schedule numerics at the MPMD operating points (ISSUE-19).
+
+``make_pipeline_train``'s 1F1B gradients must equal a plain
+(no-shard_map, no-schedule) full-model gradient at the microbatch
+counts the MPMD driver actually runs — ``M == n_stages`` (the minimal
+fill/drain bubble) and ``M == 2 * n_stages`` (the gradient-accumulation
+region the benchmark defaults to) — and ragged splits must be rejected
+with the actionable shape error, never silently reweighted.
+
+The model/loss factoring comes from :mod:`blendjax.parallel.mpmd`'s
+reference helpers, so this file is simultaneously the lock that
+``build_full_params`` / ``reference_stacked`` / ``reference_pieces``
+describe the SAME function as a plain dense stack — the foundation the
+process-fleet numerics test (tests/test_mpmd.py) stands on.
+
+The ``1`` in the filename is deliberate: pytest collects alphabetically
+and these are tier-1's cheapest pipeline-correctness signal, so they
+run near the front of the suite instead of behind the process-spawning
+packs (the suite runs close to its time budget).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blendjax.parallel import make_mesh
+from blendjax.parallel.mpmd import (
+    build_full_params,
+    normalize_spec,
+    reference_pieces,
+    reference_stacked,
+)
+from blendjax.parallel.pipeline import (
+    make_pipeline_train,
+    microbatch,
+    unstack_stage_params,
+)
+
+N = 4  # pipeline stages (mesh axis) — fits the 8-device test mesh
+
+
+def _spec(family="mse"):
+    return normalize_spec({
+        "family": family, "d_in": 6, "wire": 8, "d_out": 3,
+        "n_layers": N, "n_procs": N, "seed": 3,
+    })
+
+
+def _data(spec, m, mb=4, seed=1):
+    """Microbatched (M, mb, ...) inputs + the family's target record.
+
+    pg targets are packed into one (M, mb, 3) array — ``_1f1b_grads``
+    routes targets through ``lax.dynamic_index_in_dim``, which takes a
+    single array, so the dict record rides as channels and the loss
+    unpacks them (exactly what the MPMD lock test does too)."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    x = jax.random.normal(ks[0], (m, mb, spec["d_in"]), jnp.float32)
+    if spec["family"] == "mse":
+        tgt = jax.random.normal(ks[1], (m, mb, spec["d_out"]), jnp.float32)
+    else:
+        tgt = jnp.stack([
+            jax.random.randint(
+                ks[1], (m, mb), 0, spec["d_out"]
+            ).astype(jnp.float32),
+            jax.random.normal(ks[2], (m, mb), jnp.float32),
+            jnp.ones((m, mb), jnp.float32),
+        ], axis=-1)
+    return x, tgt
+
+
+def _array_loss_fn(spec):
+    """The family loss over the packed array target (see ``_data``)."""
+    _, _, _, loss_fn = reference_pieces(spec)
+    if spec["family"] == "mse":
+        return lambda pred, t: loss_fn(pred, {"y": t})
+    return lambda pred, t: loss_fn(pred, {
+        "action": t[..., 0].astype(jnp.int32),
+        "adv": t[..., 1],
+        "w": t[..., 2],
+    })
+
+
+def _plain_loss(stacked, proj, x, tgt, spec):
+    """The reference WITHOUT any pipeline machinery: unstack, run the
+    stages sequentially per microbatch, mean the microbatch losses."""
+    in_proj, stage_fn, out_proj, _ = reference_pieces(spec)
+    loss_fn = _array_loss_fn(spec)
+    stages = unstack_stage_params(stacked, spec["n_procs"])
+
+    def one(mb, t):
+        h = in_proj(proj[0], mb)
+        for sp in stages:
+            h = stage_fn(sp, h)
+        return loss_fn(out_proj(proj[1], h), t)
+
+    return jnp.mean(jax.vmap(one)(x, tgt))
+
+
+@pytest.mark.parametrize("family", ["mse", "pg"])
+@pytest.mark.parametrize("m", [N, 2 * N])
+def test_1f1b_grads_match_plain_reference(family, m):
+    """Loss AND every gradient leaf match the plain full-model autodiff
+    at M == n_stages and M == 2*n_stages."""
+    spec = _spec(family)
+    mesh = make_mesh({"pipe": N})
+    stacked, proj = reference_stacked(build_full_params(spec), spec)
+    in_proj, stage_fn, out_proj, _ = reference_pieces(spec)
+    x, tgt = _data(spec, m)
+
+    train = make_pipeline_train(
+        stage_fn, _array_loss_fn(spec), mesh, schedule="1f1b",
+        in_proj=in_proj, out_proj=out_proj,
+    )
+    loss, (gs, gp) = jax.jit(train)(stacked, proj, x, tgt)
+
+    ref_loss, (rgs, rgp) = jax.value_and_grad(
+        _plain_loss, argnums=(0, 1)
+    )(stacked, proj, x, tgt, spec)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        (gs, gp), (rgs, rgp),
+    )
+
+
+def test_ragged_microbatch_rejected_with_shapes():
+    """A batch that does not divide into M names the offending leaf
+    shape, the remainder, AND two nearest working batch sizes — the
+    error a misconfigured learner actually hits."""
+    with pytest.raises(ValueError, match="divisible") as ei:
+        microbatch({"obs": jnp.zeros((22, 6))}, 4)
+    text = str(ei.value)
+    assert "(22, 6)" in text
+    assert "remainder 2" in text
+    assert "batch 20 or 24" in text
+    with pytest.raises(ValueError, match=">= 1"):
+        microbatch(jnp.zeros((8, 2)), 0)

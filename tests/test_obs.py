@@ -42,6 +42,8 @@ from blendjax.utils.timing import (
     GATEWAY_STAGES,
     HA_EVENTS,
     HA_STAGES,
+    PIPE_EVENTS,
+    PIPE_STAGES,
     REPLAY_EVENTS,
     REPLAY_STAGES,
     SCENARIO_EVENTS,
@@ -217,11 +219,11 @@ def test_scrape_zero_fill_contract():
     snap = hub.scrape()
     for name in FLEET_EVENTS + REPLAY_EVENTS + SERVE_EVENTS \
             + GATEWAY_EVENTS + WEIGHT_EVENTS + SCENARIO_EVENTS \
-            + HA_EVENTS + AUTOSCALE_EVENTS:
+            + HA_EVENTS + AUTOSCALE_EVENTS + PIPE_EVENTS:
         assert snap["counters"][name] == 0, name
     for stage in FEED_STAGES + REPLAY_STAGES + SERVE_STAGES \
             + GATEWAY_STAGES + WEIGHT_STAGES + SCENARIO_STAGES \
-            + HA_STAGES + AUTOSCALE_STAGES:
+            + HA_STAGES + AUTOSCALE_STAGES + PIPE_STAGES:
         rec = snap["stages"][stage]
         assert rec["count"] == 0, stage
         assert rec["p99_ms"] == 0.0
@@ -860,6 +862,34 @@ def test_documented_autoscale_counters_exist_in_tuples():
         "## Counter vocabulary",
     )
     vocab = set(AUTOSCALE_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_pipe_counters_exist_in_tuples():
+    """The MPMD-pipeline vocabulary lock (ISSUE-19 tentpole): every
+    ``PIPE_EVENTS`` counter docs/pipeline.md tabulates exists in the
+    tuple and every tuple name is tabulated — both directions, same
+    contract as the other vocabularies."""
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "pipeline.md"),
+        "## Counter vocabulary",
+    )
+    vocab = set(PIPE_EVENTS)
+    missing = [n for n in names if n not in vocab]
+    assert not missing, f"documented but not in tuples: {missing}"
+    absent = [n for n in vocab if n not in set(names)]
+    assert not absent, f"in tuples but not tabulated: {absent}"
+
+
+def test_documented_pipe_stages_exist_in_tuples():
+    names = _doc_table_names(
+        os.path.join(REPO, "docs", "pipeline.md"),
+        "## Stage vocabulary",
+    )
+    vocab = set(PIPE_STAGES)
     missing = [n for n in names if n not in vocab]
     assert not missing, f"documented but not in tuples: {missing}"
     absent = [n for n in vocab if n not in set(names)]

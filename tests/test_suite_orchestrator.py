@@ -74,10 +74,15 @@ def test_hung_backend_cannot_zero_the_artifact():
     """Init hangs past the grace window (round 2's failure mode): the
     parent must still deliver host_stream AND a cpu fallback child's
     stream phases, each honestly labeled."""
+    # The parent intentionally waits out the WHOLE remaining budget on
+    # the hung device child (a slow backend may still come up late), so
+    # this test's wall time IS the budget: the fake-hung child sleeps
+    # 600 s and can never arrive, every asserted phase completes well
+    # inside 60 s, and the rest would be pure tier-1 sleep.
     phases = _run_suite(
         {"JAX_PLATFORMS": "cpu", "BJX_FAKE_SLOW_INIT_S": "600"},
-        ["--budget", "110", "--device-init-grace", "8"],
-        timeout=240,
+        ["--budget", "60", "--device-init-grace", "8"],
+        timeout=180,
     )
     assert "boot" in phases
     assert phases["host_stream"]["items_per_sec"] > 0
@@ -91,6 +96,8 @@ def test_hung_backend_cannot_zero_the_artifact():
     assert "device_init" not in phases
 
 
+@pytest.mark.slow  # wall-clock-bound: bench.py runs real phases for most
+#                    of the degraded budget (~90 s); `make test` runs it
 @pytest.mark.parametrize("degraded_env", [
     {"JAX_PLATFORMS": "cpu", "BJX_FAKE_SLOW_INIT_S": "600"},
 ])

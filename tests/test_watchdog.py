@@ -214,7 +214,14 @@ def test_multiring_respawn_heals(fake_blender):
     the reader kept polling the dead generation's mapping forever while
     the sibling ring's deliveries reset the timeout clock.  After the fix,
     killing one of two producers must heal that producer's stream while
-    the other keeps flowing."""
+    the other keeps flowing.
+
+    Also the kill-one-producer /dev/shm hygiene witness: the watchdog
+    respawn path sweeps the dead instance's objects (``unlink_base`` on
+    its per-instance address prefixes — the same base-prefix discipline
+    as ShmRPC) before relaunching, so the healed fleet owns EXACTLY the
+    object set it launched with, and teardown leaves zero."""
+    import glob
     import os
     import signal
 
@@ -235,6 +242,7 @@ def test_multiring_respawn_heals(fake_blender):
         background=True,
     ) as bl:
         addrs = bl.launch_info.addresses["DATA"]
+        launch_base = bl._shm_base
         with FleetWatchdog(bl, interval=0.2, restart=True) as wd:
             # num_workers=1: this single worker owns both rings -> the
             # rotation polls each with timeout 0
@@ -244,6 +252,11 @@ def test_multiring_respawn_heals(fake_blender):
             while min(seen.values()) < 3:  # both rings flowing
                 m = next(it)
                 seen[m["btid"]] += 1
+
+            # the live fleet's full /dev/shm object set under the
+            # nonce'd launch prefix — the respawn-hygiene baseline
+            baseline = sorted(glob.glob(f"/dev/shm/{launch_base}*"))
+            assert baseline  # shm proto: the rings are there
 
             proc = bl.launch_info.processes[0]
             os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
@@ -265,4 +278,14 @@ def test_multiring_respawn_heals(fake_blender):
             assert healed, "killed producer's ring never healed"
             assert got_other > 0  # sibling kept flowing across the crash
             assert wd.deaths and wd.deaths[0][2] is True
+
+            # respawn-path hygiene: the dead incarnation's objects were
+            # swept before the relaunch recreated the live set — the
+            # healed fleet owns exactly the baseline names, no stale
+            # generation accumulated alongside them
+            healed_set = sorted(glob.glob(f"/dev/shm/{launch_base}*"))
+            assert healed_set == baseline
         it.close()
+    # teardown hygiene despite the SIGKILL mid-run: zero objects leak
+    # under the launch prefix
+    assert not glob.glob(f"/dev/shm/{launch_base}*")
